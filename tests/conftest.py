@@ -1,0 +1,19 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the survey's MiniCluster lesson applied to JAX (SURVEY.md section 4):
+fake the substrate (devices), keep every framework code path real. Multi-chip
+sharding logic runs on 8 virtual CPU devices; single-chip TPU correctness is
+exercised separately by bench.py on real hardware.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,136 @@
+"""Config-system tests.
+
+Mirrors the reference's conf-parsing unit tests plus its defaults-vs-docs
+consistency test (SURVEY.md sections 4 and 5).
+"""
+
+import os
+
+import pytest
+
+from tony_tpu.config import DEFAULTS, Keys, TaskTypeSpec, TonyConfig, job_key
+
+
+def test_defaults_layer():
+    cfg = TonyConfig()
+    assert cfg.get_str(Keys.APPLICATION_FRAMEWORK) == "jax"
+    assert cfg.get_int(Keys.TASK_HEARTBEAT_INTERVAL_MS) == 1000
+    assert cfg.get_bool(Keys.APPLICATION_SECURITY_ENABLED) is False
+    assert cfg.get_str(Keys.SCHEDULER_MODE) == "GANG"
+
+
+def test_every_default_key_is_a_registered_key():
+    registered = {
+        v for k, v in vars(Keys).items() if not k.startswith("_") and isinstance(v, str)
+    }
+    assert set(DEFAULTS) <= registered
+
+
+def test_toml_layer_overrides_defaults(tmp_path):
+    toml = tmp_path / "tony.toml"
+    toml.write_text(
+        """
+[application]
+name = "mnist"
+framework = "tensorflow"
+
+[job.worker]
+instances = 4
+memory_mb = 4096
+tpu_chips = 1
+command = "python train.py"
+
+[job.ps]
+instances = 2
+depends_on = ""
+
+[job.tensorboard]
+instances = 1
+untracked = true
+"""
+    )
+    cfg = TonyConfig.load(toml)
+    assert cfg.get_str(Keys.APPLICATION_NAME) == "mnist"
+    assert cfg.get_str(Keys.APPLICATION_FRAMEWORK) == "tensorflow"
+    assert sorted(cfg.job_types()) == ["ps", "tensorboard", "worker"]
+    w = cfg.task_spec("worker")
+    assert w == TaskTypeSpec(
+        name="worker",
+        instances=4,
+        memory_mb=4096,
+        tpu_chips=1,
+        command="python train.py",
+    )
+    assert cfg.task_spec("tensorboard").untracked is True
+    # defaults still visible underneath
+    assert cfg.get_int(Keys.TASK_MAX_MISSED_HEARTBEATS) == 25
+
+
+def test_cli_overrides_beat_toml(tmp_path):
+    toml = tmp_path / "tony.toml"
+    toml.write_text("[job.worker]\ninstances = 4\n")
+    cfg = TonyConfig.load(toml, overrides=["job.worker.instances=8", "am.rpc_port=5555"])
+    assert cfg.task_spec("worker").instances == 8
+    assert cfg.get_int(Keys.AM_RPC_PORT) == 5555
+
+
+def test_cli_override_type_inference():
+    cfg = TonyConfig.load(
+        overrides=["a.b=true", "a.c=3", "a.d=1.5", "a.e=hello", "a.f=false"]
+    )
+    assert cfg.get("a.b") is True
+    assert cfg.get("a.c") == 3
+    assert cfg.get("a.d") == 1.5
+    assert cfg.get("a.e") == "hello"
+    assert cfg.get("a.f") is False
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("TONY_CONF_application__name", "from-env")
+    cfg = TonyConfig.load(read_env=True)
+    assert cfg.get_str(Keys.APPLICATION_NAME) == "from-env"
+
+
+def test_json_roundtrip_ships_identical_config(tmp_path):
+    toml = tmp_path / "tony.toml"
+    toml.write_text("[job.worker]\ninstances = 3\nenv = [\"A=1\", \"B=2\"]\n")
+    cfg = TonyConfig.load(toml, overrides=["x.y=42"])
+    clone = TonyConfig.from_json(cfg.to_json())
+    assert clone.to_dict() == cfg.to_dict()
+    assert clone.task_spec("worker").env == {"A": "1", "B": "2"}
+
+
+def test_get_list_accepts_csv_and_lists():
+    cfg = TonyConfig({"l1": "a, b ,c", "l2": ["x", "y"]})
+    assert cfg.get_list("l1") == ["a", "b", "c"]
+    assert cfg.get_list("l2") == ["x", "y"]
+    assert cfg.get_list("missing", ["d"]) == ["d"]
+
+
+def test_job_key_templating():
+    assert job_key("evaluator", "tpu_chips") == "job.evaluator.tpu_chips"
+
+
+def test_bad_override_raises():
+    with pytest.raises(ValueError):
+        TonyConfig.load(overrides=["no-equals-sign"])
+
+
+def test_env_entry_without_equals_raises():
+    cfg = TonyConfig({"job.w.env": ["FOO"]})
+    with pytest.raises(ValueError, match="FOO"):
+        cfg.task_spec("w")
+
+
+def test_untracked_string_false_is_false():
+    cfg = TonyConfig({"job.tb.untracked": "false", "job.tb2.untracked": "true"})
+    assert cfg.task_spec("tb").untracked is False
+    assert cfg.task_spec("tb2").untracked is True
+
+
+def test_job_suffixes_match_taskspec_fields():
+    import dataclasses
+    from tony_tpu.config.keys import JOB_SUFFIXES
+
+    fields = {f.name for f in dataclasses.fields(TaskTypeSpec)} - {"name"}
+    assert fields == set(JOB_SUFFIXES)

@@ -1,0 +1,35 @@
+"""Utils tests: port probing and logged subprocess lifecycle."""
+
+import io
+import socket
+
+from tony_tpu.utils import LoggedProc, find_free_port, run_logged
+
+
+def test_find_free_port_is_bindable():
+    port = find_free_port()
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", port))
+
+
+def test_run_logged_captures_full_output_and_exit_code():
+    buf = io.BytesIO()
+    lp = run_logged(
+        'python -c "import sys; [print(i) for i in range(50)]; sys.exit(3)"',
+        log_prefix="[w-0] ",
+        stdout=buf,
+    )
+    assert isinstance(lp, LoggedProc)
+    code = lp.wait(timeout=30)
+    assert code == 3
+    lines = buf.getvalue().decode().strip().splitlines()
+    assert len(lines) == 50  # tail not lost: wait() drains the pump
+    assert lines[0] == "[w-0] 0" and lines[-1] == "[w-0] 49"
+
+
+def test_run_logged_argv_form():
+    buf = io.BytesIO()
+    lp = run_logged(["python", "-c", "print('argv ok')"], stdout=buf)
+    assert lp.wait(timeout=30) == 0
+    assert b"argv ok" in buf.getvalue()

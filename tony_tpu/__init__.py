@@ -1,0 +1,24 @@
+"""tony-tpu: a TPU-native distributed-training orchestration framework.
+
+A from-scratch rebuild of the capabilities of yuriyao/TonY (LinkedIn's
+"TensorFlow on YARN" orchestrator; see SURVEY.md) designed TPU-first:
+
+- The control plane (client -> ApplicationMaster -> TaskExecutor) is a gRPC
+  service instead of Hadoop RPC (reference: tony-core/.../rpc/ApplicationRpc,
+  per SURVEY.md section 2 -- reference mount was empty, citations are to the
+  expected upstream layout).
+- The resource substrate is a pluggable ``ClusterBackend`` with a ``tpu``
+  resource type (the ``yarn.io/gpu`` analogue) instead of YARN RM/NM.
+- Framework runtimes bootstrap ``jax.distributed.initialize`` with an
+  AM-assigned coordinator address and process id (``JaxTpuRuntime``), with
+  TF_CONFIG / PyTorch env / Horovod-style rendezvous adapters for parity.
+- The data plane is compiled XLA collectives over ICI/DCN (psum, ppermute,
+  all_gather under pjit/shard_map) -- there is no NCCL/Gloo surface.
+- A training-side parallelism library (DP/FSDP/TP/PP/EP + ring-attention
+  context parallelism with Pallas kernels) that the reference delegated to
+  user frameworks is first-class here.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["__version__"]

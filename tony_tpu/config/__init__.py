@@ -1,0 +1,12 @@
+"""Layered configuration system.
+
+Rebuild of TonY's config layer (reference: tony-core/.../TonyConfigurationKeys.java
+and tony-default.xml, SURVEY.md section 2 "Config system"): defaults registry ->
+user TOML file -> CLI ``-c key=value`` overrides, with per-jobtype key templating
+(``job.<jobtype>.instances`` etc., the ``tony.<jobtype>.instances`` analogue).
+"""
+
+from tony_tpu.config.keys import Keys, DEFAULTS, job_key
+from tony_tpu.config.config import TonyConfig, TaskTypeSpec
+
+__all__ = ["Keys", "DEFAULTS", "job_key", "TonyConfig", "TaskTypeSpec"]

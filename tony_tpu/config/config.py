@@ -1,0 +1,252 @@
+"""TonyConfig: the layered configuration object.
+
+Rebuild of TonY's Hadoop-``Configuration`` XML layering (tony-default.xml ->
+user tony.xml -> ``-Dtony.k=v`` CLI; SURVEY.md section 5 "Config/flag system"),
+TPU-era: defaults registry -> TOML file -> ``key=value`` CLI overrides ->
+``TONY_CONF_<KEY>`` env overrides. Values are JSON-serialisable so a config can
+be shipped verbatim from client to AM to executors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+from tony_tpu.config.keys import DEFAULTS, job_key
+
+_ENV_PREFIX = "TONY_CONF_"
+
+
+def _apply_env(values: dict[str, Any]) -> None:
+    """Apply ``TONY_CONF_section__key=value`` environment overrides in place."""
+    for name, raw in os.environ.items():
+        if name.startswith(_ENV_PREFIX):
+            key = name[len(_ENV_PREFIX):].lower().replace("__", ".")
+            values[key] = _coerce(raw)
+
+
+def _flatten(tree: dict[str, Any], prefix: str = "") -> Iterator[tuple[str, Any]]:
+    for k, v in tree.items():
+        dotted = f"{prefix}{k}"
+        if isinstance(v, dict):
+            yield from _flatten(v, f"{dotted}.")
+        else:
+            yield dotted, v
+
+
+def _coerce(raw: str) -> Any:
+    """Type-infer a CLI/env override string the way Hadoop's getInt/getBoolean do."""
+    low = raw.strip().lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    return raw
+
+
+@dataclass(frozen=True)
+class TaskTypeSpec:
+    """Resolved per-jobtype spec (the ``tony.<jobtype>.*`` key group).
+
+    Reference: per-jobtype resource keys consumed by TonyApplicationMaster when
+    building container requests (SURVEY.md section 2, "TonyApplicationMaster").
+    """
+
+    name: str
+    instances: int = 1
+    memory_mb: int = 2048
+    cpus: int = 1
+    tpu_chips: int = 0
+    command: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    depends_on: str = ""
+    depends_timeout_s: int = 0
+    untracked: bool = False
+    node_label: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "instances": self.instances,
+            "memory_mb": self.memory_mb,
+            "cpus": self.cpus,
+            "tpu_chips": self.tpu_chips,
+            "command": self.command,
+            "env": dict(self.env),
+            "depends_on": self.depends_on,
+            "depends_timeout_s": self.depends_timeout_s,
+            "untracked": self.untracked,
+            "node_label": self.node_label,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TaskTypeSpec":
+        return cls(**d)
+
+
+class TonyConfig:
+    """Layered key/value configuration with typed accessors.
+
+    Layers, lowest to highest precedence:
+      1. ``DEFAULTS`` (the tony-default.xml analogue)
+      2. a TOML file (the user tony.xml analogue)
+      3. explicit ``set``/CLI ``key=value`` overrides
+      4. ``TONY_CONF_*`` environment overrides (read at construction)
+    """
+
+    def __init__(self, values: dict[str, Any] | None = None, *, read_env: bool = False):
+        self._values: dict[str, Any] = dict(DEFAULTS)
+        if values:
+            self._values.update(values)
+        if read_env:
+            _apply_env(self._values)
+
+    # --- construction -----------------------------------------------------
+
+    @classmethod
+    def load(
+        cls,
+        toml_path: str | os.PathLike[str] | None = None,
+        overrides: list[str] | dict[str, Any] | None = None,
+        *,
+        read_env: bool = False,
+    ) -> "TonyConfig":
+        cfg = cls(read_env=False)
+        if toml_path:
+            with open(toml_path, "rb") as f:
+                tree = tomllib.load(f)
+            for k, v in _flatten(tree):
+                cfg._values[k] = v
+        if isinstance(overrides, dict):
+            cfg._values.update(overrides)
+        elif overrides:
+            for item in overrides:
+                if "=" not in item:
+                    raise ValueError(f"override must be key=value, got {item!r}")
+                k, _, v = item.partition("=")
+                cfg._values[k.strip()] = _coerce(v)
+        if read_env:
+            _apply_env(cfg._values)
+        return cfg
+
+    # --- typed accessors ---------------------------------------------------
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    def get_str(self, key: str, default: str = "") -> str:
+        v = self._values.get(key, default)
+        return "" if v is None else str(v)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self._values.get(key, default)
+        return int(v)
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self._values.get(key, default)
+        return float(v)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self._values.get(key, default)
+        if isinstance(v, str):
+            return v.strip().lower() == "true"
+        return bool(v)
+
+    def get_list(self, key: str, default: list[str] | None = None) -> list[str]:
+        v = self._values.get(key)
+        if v is None:
+            return list(default or [])
+        if isinstance(v, list):
+            return [str(x) for x in v]
+        return [s.strip() for s in str(v).split(",") if s.strip()]
+
+    def set(self, key: str, value: Any) -> None:
+        self._values[key] = value
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._values
+
+    # --- per-jobtype resolution ---------------------------------------------
+
+    def job_types(self) -> list[str]:
+        """Discover configured job types from ``job.<type>.*`` keys.
+
+        The reference discovers task types by scanning ``tony.<jobtype>.instances``
+        keys (Utils.getAllJobTypes analogue).
+        """
+        types: list[str] = []
+        for k in self._values:
+            if k.startswith("job.") and k.count(".") >= 2:
+                t = k.split(".", 2)[1]
+                if t not in types:
+                    types.append(t)
+        return types
+
+    def task_spec(self, job_type: str) -> TaskTypeSpec:
+        def g(suffix: str, default: Any) -> Any:
+            return self._values.get(job_key(job_type, suffix), default)
+
+        env_val = g("env", {})
+        if isinstance(env_val, str):
+            env_val = [s for s in env_val.split(",") if s.strip()]
+        if isinstance(env_val, list):  # ["K=V", ...] form from TOML/CLI
+            pairs = {}
+            for item in env_val:
+                if "=" not in item:
+                    raise ValueError(
+                        f"env entry {item!r} for job type {job_type!r} must be KEY=VALUE"
+                    )
+                k, _, v = str(item).partition("=")
+                pairs[k] = v
+            env_val = pairs
+        elif not isinstance(env_val, dict):
+            env_val = {}
+
+        def as_bool(v: Any) -> bool:
+            if isinstance(v, str):
+                return v.strip().lower() == "true"
+            return bool(v)
+        return TaskTypeSpec(
+            name=job_type,
+            instances=int(g("instances", 1)),
+            memory_mb=int(g("memory_mb", 2048)),
+            cpus=int(g("cpus", 1)),
+            tpu_chips=int(g("tpu_chips", 0)),
+            command=str(g("command", "")),
+            env={str(k): str(v) for k, v in env_val.items()},
+            depends_on=str(g("depends_on", "")),
+            depends_timeout_s=int(g("depends_timeout_s", 0)),
+            untracked=as_bool(g("untracked", False)),
+            node_label=str(g("node_label", "")),
+        )
+
+    def task_specs(self) -> dict[str, TaskTypeSpec]:
+        return {t: self.task_spec(t) for t in self.job_types()}
+
+    # --- serialisation (ship client -> AM -> executor) -----------------------
+
+    def to_json(self) -> str:
+        return json.dumps(self._values, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, blob: str) -> "TonyConfig":
+        return cls(json.loads(blob))
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self._values)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        n = len(self._values)
+        return f"TonyConfig({n} keys, framework={self.get_str(Keys.APPLICATION_FRAMEWORK)})"
+
+
+__all__ = ["TonyConfig", "TaskTypeSpec"]

@@ -1,0 +1,138 @@
+"""Configuration key registry and defaults.
+
+The analogue of TonY's ``TonyConfigurationKeys`` (all keys centralised, named
+``tony.*``) plus ``tony-default.xml`` (baked-in defaults layer). See SURVEY.md
+section 2 "Config system" and section 5 "Config/flag system". Keys here use
+plain dotted names; per-jobtype keys are templated via :func:`job_key`.
+"""
+
+from __future__ import annotations
+
+
+class Keys:
+    """Centralised configuration key names (TonyConfigurationKeys analogue)."""
+
+    # --- application-level ---
+    APPLICATION_NAME = "application.name"
+    APPLICATION_FRAMEWORK = "application.framework"  # jax | tensorflow | pytorch | horovod | generic
+    APPLICATION_QUEUE = "application.queue"
+    APPLICATION_SECURITY_ENABLED = "application.security.enabled"
+    APPLICATION_TIMEOUT_S = "application.timeout_s"  # 0 = no timeout
+    APPLICATION_PREPARE_STAGE_DIR = "application.stage_dir"
+    APPLICATION_TAGS = "application.tags"
+
+    # --- AM (ApplicationMaster) ---
+    AM_MEMORY_MB = "am.memory_mb"
+    AM_CPUS = "am.cpus"
+    AM_RETRY_COUNT = "am.retry_count"  # tony.am.retry-count analogue
+    AM_RPC_PORT = "am.rpc_port"  # 0 = ephemeral
+    AM_EVENT_DIR = "am.event_dir"  # history event output dir (jhist analogue)
+    AM_ALLOCATION_TIMEOUT_S = "am.allocation_timeout_s"  # gang partial-alloc guard
+
+    # --- task supervision ---
+    TASK_HEARTBEAT_INTERVAL_MS = "task.heartbeat_interval_ms"
+    TASK_MAX_MISSED_HEARTBEATS = "task.max_missed_heartbeats"
+    TASK_REGISTRATION_TIMEOUT_S = "task.registration_timeout_s"
+    TASK_MAX_TOTAL_INSTANCES = "task.max_total_instances"
+    TASK_EXECUTOR_PYTHON = "task.executor.python"  # python binary for executors
+
+    # --- elastic / restart policy ---
+    RESTART_MAX_WORKER_RESTARTS = "restart.max_worker_restarts"
+    RESTART_POLICY = "restart.policy"  # never | failed_only | gang
+    RESTART_RESUME_FROM_CHECKPOINT = "restart.resume_from_checkpoint"
+
+    # --- distributed mode ---
+    SCHEDULER_MODE = "scheduler.mode"  # GANG | FCFS (SURVEY.md: TaskScheduler modes)
+
+    # --- checkpoint glue ---
+    CHECKPOINT_DIR = "checkpoint.dir"
+    CHECKPOINT_INTERVAL_STEPS = "checkpoint.interval_steps"
+    CHECKPOINT_KEEP = "checkpoint.keep"
+
+    # --- observability ---
+    METRICS_INTERVAL_MS = "metrics.interval_ms"
+    METRICS_ENABLED = "metrics.enabled"
+    PROFILER_ENABLED = "profiler.enabled"
+    PROFILER_PORT = "profiler.port"
+
+    # --- cluster backend ---
+    CLUSTER_BACKEND = "cluster.backend"  # local | tpu_vm (stub)
+    CLUSTER_MAX_CONTAINERS = "cluster.max_containers"
+    CLUSTER_TPU_CHIPS_PER_HOST = "cluster.tpu_chips_per_host"
+
+    # --- docker parity (reference: tony docker keys; local backend ignores) ---
+    DOCKER_ENABLED = "docker.enabled"
+    DOCKER_IMAGE = "docker.image"
+
+    # --- portal/history ---
+    HISTORY_INTERMEDIATE_DIR = "history.intermediate_dir"
+    HISTORY_FINISHED_DIR = "history.finished_dir"
+    PORTAL_PORT = "portal.port"
+
+
+# Per-jobtype key suffixes (the ``tony.<jobtype>.<suffix>`` templating scheme).
+JOB_SUFFIXES = (
+    "instances",
+    "memory_mb",
+    "cpus",
+    "tpu_chips",
+    "command",
+    "env",
+    "depends_on",  # inter-task-type dependency (workers wait on ps)
+    "depends_timeout_s",
+    "untracked",  # excluded from job final-status accounting (e.g. tensorboard)
+    "node_label",
+)
+
+
+def job_key(job_type: str, suffix: str) -> str:
+    """``job_key("worker", "instances") -> "job.worker.instances"``.
+
+    Analogue of TonY's per-jobtype conf templating
+    (``tony.<jobtype>.instances`` / ``.memory`` / ``.vcores`` / ``.gpus``).
+    """
+    return f"job.{job_type}.{suffix}"
+
+
+# The tony-default.xml analogue: the base layer of every TonyConfig.
+# tests/test_config.py pins these against docs (reference had a
+# defaults-vs-docs consistency test, SURVEY.md section 5).
+DEFAULTS: dict[str, object] = {
+    Keys.APPLICATION_NAME: "tony-tpu-job",
+    Keys.APPLICATION_FRAMEWORK: "jax",
+    Keys.APPLICATION_QUEUE: "default",
+    Keys.APPLICATION_SECURITY_ENABLED: False,
+    Keys.APPLICATION_TIMEOUT_S: 0,
+    Keys.APPLICATION_PREPARE_STAGE_DIR: "",
+    Keys.APPLICATION_TAGS: "",
+    Keys.AM_MEMORY_MB: 2048,
+    Keys.AM_CPUS: 1,
+    Keys.AM_RETRY_COUNT: 0,
+    Keys.AM_RPC_PORT: 0,
+    Keys.AM_EVENT_DIR: "",
+    Keys.AM_ALLOCATION_TIMEOUT_S: 300,
+    Keys.TASK_HEARTBEAT_INTERVAL_MS: 1000,
+    Keys.TASK_MAX_MISSED_HEARTBEATS: 25,
+    Keys.TASK_REGISTRATION_TIMEOUT_S: 300,
+    Keys.TASK_MAX_TOTAL_INSTANCES: -1,
+    Keys.TASK_EXECUTOR_PYTHON: "",
+    Keys.RESTART_MAX_WORKER_RESTARTS: 0,
+    Keys.RESTART_POLICY: "never",
+    Keys.RESTART_RESUME_FROM_CHECKPOINT: True,
+    Keys.SCHEDULER_MODE: "GANG",
+    Keys.CHECKPOINT_DIR: "",
+    Keys.CHECKPOINT_INTERVAL_STEPS: 0,
+    Keys.CHECKPOINT_KEEP: 3,
+    Keys.METRICS_INTERVAL_MS: 2000,
+    Keys.METRICS_ENABLED: True,
+    Keys.PROFILER_ENABLED: False,
+    Keys.PROFILER_PORT: 9999,
+    Keys.CLUSTER_BACKEND: "local",
+    Keys.CLUSTER_MAX_CONTAINERS: 64,
+    Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
+    Keys.DOCKER_ENABLED: False,
+    Keys.DOCKER_IMAGE: "",
+    Keys.HISTORY_INTERMEDIATE_DIR: "",
+    Keys.HISTORY_FINISHED_DIR: "",
+    Keys.PORTAL_PORT: 8080,
+}

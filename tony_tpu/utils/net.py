@@ -1,0 +1,28 @@
+"""Network helpers.
+
+The reference's executors bind-probe for free ports to build host:port cluster
+specs (SURVEY.md section 5 notes this as a known race-prone wart). In the TPU
+build only the AM RPC endpoint and the jax.distributed coordinator need ports;
+ICI/DCN endpoints are invisible to user code, which shrinks the race window to
+the coordinator port only.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def local_host() -> str:
+    return socket.gethostname()
+
+
+def find_free_port(host: str = "") -> int:
+    """Bind-probe an ephemeral port and release it.
+
+    Racy by construction (the port can be taken between release and reuse);
+    callers that can, should bind port 0 themselves and report what they got.
+    """
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]

@@ -1,0 +1,84 @@
+"""Subprocess helpers (the TaskExecutor.executeShell analogue)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+from dataclasses import dataclass
+from typing import IO, Mapping, Sequence
+
+
+def _pump(src: IO[bytes], dst: IO[bytes], prefix: bytes) -> None:
+    for line in iter(src.readline, b""):
+        try:
+            dst.write(prefix + line)
+            dst.flush()
+        except ValueError:  # dst closed
+            break
+    src.close()
+
+
+@dataclass
+class LoggedProc:
+    """A child process plus its log-pump thread.
+
+    ``wait()`` drains the pump before returning so the tail of the child's
+    output (typically the crash traceback) is never lost — the exact contract
+    the reference executor needs ("stream logs, then propagate exit code",
+    SURVEY.md section 2 "TaskExecutor").
+    """
+
+    proc: subprocess.Popen[bytes]
+    pump: threading.Thread
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def poll(self) -> int | None:
+        return self.proc.poll()
+
+    def terminate(self) -> None:
+        self.proc.terminate()
+
+    def kill(self) -> None:
+        self.proc.kill()
+
+    def wait(self, timeout: float | None = None) -> int:
+        code = self.proc.wait(timeout)
+        self.pump.join(timeout=10)
+        return code
+
+
+def run_logged(
+    command: str | Sequence[str],
+    *,
+    env: Mapping[str, str] | None = None,
+    cwd: str | None = None,
+    log_prefix: str = "",
+    stdout: IO[bytes] | None = None,
+) -> LoggedProc:
+    """Start a command, streaming its output line-by-line with a prefix.
+
+    A string runs through the shell (user ``command`` strings from config);
+    a sequence execs argv directly.
+    """
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    proc = subprocess.Popen(
+        command,
+        shell=isinstance(command, str),
+        env=full_env,
+        cwd=cwd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    out = stdout if stdout is not None else sys.stdout.buffer
+    t = threading.Thread(
+        target=_pump, args=(proc.stdout, out, log_prefix.encode()), daemon=True
+    )
+    t.start()
+    return LoggedProc(proc, t)
