@@ -1,0 +1,92 @@
+"""Benchmark: Llama train-step throughput on the local chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
+
+The reference publishes no throughput numbers (BASELINE.md: "published": {});
+the driver's north star is tokens/sec/chip and >= 45% MFU, so ``vs_baseline``
+reports achieved MFU / 0.45 (1.0 = the north-star target).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def run_bench() -> dict:
+    from tony_tpu.models.llama import LlamaConfig, train_flops_per_token
+    from tony_tpu.obs.metrics import StepTimer, chip_peak_flops
+    from tony_tpu.parallel.mesh import single_device_mesh
+    from tony_tpu.train.trainer import default_optimizer, make_train_state, make_train_step
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig.bench_410m()
+        batch, seq, steps = 8, 2048, 10
+    else:  # CPU fallback so the driver always gets a line
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 4, 64, 3
+
+    mesh = single_device_mesh()
+    opt = default_optimizer(warmup_steps=10, decay_steps=1000)
+    state = make_train_state(jax.random.key(0), cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    # warmup / compile. NOTE: float() (device_get) is the sync point --
+    # block_until_ready is not a reliable fence on the axon relay platform.
+    state, metrics = step(state, inputs, targets)
+    state, metrics = step(state, inputs, targets)
+    float(metrics["loss"])
+
+    timer = StepTimer(
+        flops_per_token=train_flops_per_token(cfg, seq),
+        tokens_per_step=batch * seq,
+        n_chips=1,
+    )
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, inputs, targets)
+    final_loss = float(metrics["loss"])  # sync fence
+    timer.record(time.perf_counter() - t0, steps)
+
+    peak = chip_peak_flops()
+    mfu = timer.mfu(peak)
+    return {
+        "metric": "llama410m_train_tokens_per_sec_per_chip"
+        if on_tpu
+        else "llama_tiny_cpu_tokens_per_sec",
+        "value": round(timer.tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "device": jax.devices()[0].device_kind,
+            "n_params": cfg.n_params,
+            "batch": batch,
+            "seq": seq,
+            "steps": steps,
+            "loss": round(final_loss, 4),
+        },
+    }
+
+
+if __name__ == "__main__":
+    try:
+        result = run_bench()
+    except Exception as e:  # never leave the driver without a line
+        result = {
+            "metric": "bench_error",
+            "value": 0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "extra": {"error": f"{type(e).__name__}: {e}"},
+        }
+    print(json.dumps(result))
+    sys.exit(0)

@@ -9,7 +9,7 @@ exercised separately by bench.py on real hardware.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # env presets axon (TPU); tests force CPU
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,3 +17,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# /root/.axon_site/sitecustomize.py imports jax at interpreter start, which
+# latches JAX_PLATFORMS=axon before this file runs -- override via the API
+# (the backend itself is created lazily, so this still wins).
+import jax
+
+jax.config.update("jax_platforms", "cpu")

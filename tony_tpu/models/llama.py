@@ -1,0 +1,294 @@
+"""Llama-2-family decoder transformer, TPU-first.
+
+The reference framework contains no models (TonY delegates training code to
+user scripts; SURVEY.md section 0). This module is the training-side library
+the rebuild adds, designed for the MXU/XLA rather than translated from torch:
+
+- parameters are a plain pytree of stacked per-layer arrays; the layer stack
+  runs under ``lax.scan`` (one trace, one compile, pipeline-ready layout);
+- compute dtype bfloat16 end-to-end, softmax/norm statistics and the final
+  loss in float32;
+- optional ``jax.checkpoint`` rematerialisation per layer (HBM for FLOPs);
+- every parameter carries logical axis names (see
+  tony_tpu.parallel.sharding.DEFAULT_RULES) so the same code runs single-chip,
+  FSDP, Megatron-TP, or sequence-parallel purely by mesh choice;
+- attention is pluggable: plain fused attention here, Pallas flash attention
+  and ring attention (context parallelism) from tony_tpu.ops/.parallel.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict[str, Any]
+AttnFn = Callable[..., jax.Array]  # (q, k, v, cfg) -> out
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # 'dot' = fused plain attention; 'flash' = pallas kernel (tony_tpu.ops);
+    # 'ring' = sequence-parallel ring attention (tony_tpu.parallel).
+    attention_impl: str = "dot"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def n_params(self) -> int:
+        """Exact parameter count (embeddings included, tied=False)."""
+        d, h = self.dim, self.head_dim
+        attn = d * self.n_heads * h + 2 * d * self.n_kv_heads * h + self.n_heads * h * d
+        ffn = 3 * d * self.ffn_dim
+        norms = 2 * d
+        per_layer = attn + ffn + norms
+        return self.vocab_size * d * 2 + self.n_layers * per_layer + d
+
+    # --- presets -----------------------------------------------------------
+
+    @classmethod
+    def llama2_7b(cls, **kw: Any) -> "LlamaConfig":
+        return cls(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+            ffn_dim=11008, max_seq_len=4096, **kw,
+        )
+
+    @classmethod
+    def llama2_13b(cls, **kw: Any) -> "LlamaConfig":
+        return cls(
+            vocab_size=32000, dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+            ffn_dim=13824, max_seq_len=4096, **kw,
+        )
+
+    @classmethod
+    def llama3_8b(cls, **kw: Any) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            ffn_dim=14336, max_seq_len=8192, rope_theta=500000.0, **kw,
+        )
+
+    @classmethod
+    def bench_410m(cls, **kw: Any) -> "LlamaConfig":
+        """~410M-param config that trains comfortably on one v5e chip."""
+        return cls(
+            vocab_size=32000, dim=1024, n_layers=24, n_heads=16, n_kv_heads=16,
+            ffn_dim=2816, max_seq_len=2048, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw: Any) -> "LlamaConfig":
+        """Test-size config (CPU-fast)."""
+        kw.setdefault("dtype", jnp.float32)
+        kw.setdefault("remat", False)
+        return cls(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=64, **kw,
+        )
+
+
+# --- parameter tree -----------------------------------------------------------
+
+
+def logical_axes(cfg: LlamaConfig) -> Params:
+    """Pytree (matching init_params) of logical axis-name tuples.
+
+    Sharding follows the Megatron+FSDP recipe: wide dims (heads/ffn/vocab) on
+    ``tp``, model dim on ``fsdp``; the leading stacked-layer dim is never
+    sharded. tony_tpu.parallel.sharding turns these into NamedShardings.
+    """
+    return {
+        "tok_emb": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "ffn_norm": ("layers", "norm"),
+            "w1": ("layers", "embed", "ffn"),
+            "w3": ("layers", "embed", "ffn"),
+            "w2": ("layers", "ffn", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    """Initialise the parameter pytree (per-layer arrays stacked on axis 0)."""
+    d, hd = cfg.dim, cfg.head_dim
+    nq, nkv, L = cfg.n_heads * hd, cfg.n_kv_heads * hd, cfg.n_layers
+    keys = jax.random.split(rng, 9)
+
+    def dense(key: jax.Array, shape: tuple[int, ...], fan_in: int) -> jax.Array:
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    return {
+        "tok_emb": dense(keys[0], (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "wq": dense(keys[1], (L, d, nq), d),
+            "wk": dense(keys[2], (L, d, nkv), d),
+            "wv": dense(keys[3], (L, d, nkv), d),
+            "wo": dense(keys[4], (L, nq, d), nq),
+            "ffn_norm": jnp.ones((L, d), cfg.dtype),
+            "w1": dense(keys[5], (L, d, cfg.ffn_dim), d),
+            "w3": dense(keys[6], (L, d, cfg.ffn_dim), d),
+            "w2": dense(keys[7], (L, cfg.ffn_dim, d), cfg.ffn_dim),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": dense(keys[8], (d, cfg.vocab_size), d),
+    }
+
+
+# --- building blocks ----------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * weight
+
+
+def rope_table(cfg: LlamaConfig, seq_len: int, offset: int = 0) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables [seq, head_dim/2], float32."""
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    angles = pos[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, H, hd] -> rotated, same dtype. Pairs (even, odd) halves."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def dot_attention(q: jax.Array, k: jax.Array, v: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Plain causal attention, fp32 softmax. q:[B,S,H,hd] k/v:[B,S,H,hd]."""
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    S = q.shape[1]
+    causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+    scores = jnp.where(causal[None, None], scores * scale, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _get_attention(cfg: LlamaConfig) -> AttnFn:
+    if cfg.attention_impl == "dot":
+        return dot_attention
+    try:
+        if cfg.attention_impl == "flash":
+            from tony_tpu.ops.attention import flash_attention
+
+            return flash_attention
+        if cfg.attention_impl == "ring":
+            from tony_tpu.parallel.ring_attention import ring_attention
+
+            return ring_attention
+    except ImportError as e:
+        raise NotImplementedError(
+            f"attention_impl={cfg.attention_impl!r} backend not available: {e}"
+        ) from e
+    raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
+
+
+def attention_block(x: jax.Array, lp: Params, cfg: LlamaConfig,
+                    cos: jax.Array, sin: jax.Array) -> jax.Array:
+    B, S, _ = x.shape
+    hd = cfg.head_dim
+    q = (x @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = (x @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = (x @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if cfg.n_kv_heads != cfg.n_heads:  # GQA: expand kv heads to query heads
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    out = _get_attention(cfg)(q, k, v, cfg)
+    return out.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
+
+
+def ffn_block(x: jax.Array, lp: Params) -> jax.Array:
+    return (jax.nn.silu(x @ lp["w1"]) * (x @ lp["w3"])) @ lp["w2"]
+
+
+# --- forward ------------------------------------------------------------------
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+    x = params["tok_emb"][tokens]
+    cos, sin = rope_table(cfg, tokens.shape[1])
+
+    def block(x: jax.Array, lp: Params) -> tuple[jax.Array, None]:
+        h = x + attention_block(
+            rms_norm(x, lp["attn_norm"], cfg.norm_eps), lp, cfg, cos, sin
+        )
+        out = h + ffn_block(rms_norm(h, lp["ffn_norm"], cfg.norm_eps), lp)
+        return out, None
+
+    if cfg.remat:
+        block = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = lax.scan(block, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_from_pairs(
+    params: Params, inputs: jax.Array, targets: jax.Array, cfg: LlamaConfig
+) -> jax.Array:
+    """Cross-entropy of predicting targets [B, S] from inputs [B, S].
+
+    Pre-shifted pairs keep the sequence length identical across inputs,
+    activations, and targets, so a ``sp``-sharded seq axis stays aligned end
+    to end (no off-by-one reshard between forward and loss).
+    """
+    logits = forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Next-token cross-entropy over tokens [B, S+1] (shifts internally)."""
+    return loss_from_pairs(params, tokens[:, :-1], tokens[:, 1:], cfg)
+
+
+def train_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Approximate training FLOPs per token: 6*N (param matmuls, fwd+bwd)
+    plus the causal-attention score/value matmuls (12*L*D*S/2)."""
+    return 6.0 * cfg.n_params + 6.0 * cfg.n_layers * cfg.dim * seq_len
+
+
+__all__ = [
+    "LlamaConfig", "init_params", "logical_axes", "forward", "loss_fn",
+    "loss_from_pairs",
+    "rms_norm", "rope_table", "apply_rope", "dot_attention",
+    "train_flops_per_token",
+]

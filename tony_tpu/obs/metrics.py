@@ -1,0 +1,61 @@
+"""Throughput / MFU accounting.
+
+The reference's TaskMonitor samples cpu/mem + nvidia-smi GPU utilisation
+(SURVEY.md section 2 "TaskMonitor"); on TPU the meaningful utilisation number
+is MFU -- achieved model FLOP/s over the chip's peak -- which is also the
+north-star metric (BASELINE.md: >= 45% MFU target).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+# Peak dense bf16 FLOP/s per chip by TPU generation (public spec-sheet numbers).
+PEAK_BF16_FLOPS: dict[str, float] = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,  # axon device_kind for v5e
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 1e12,  # nominal; keeps MFU finite in CPU tests
+}
+
+
+def chip_peak_flops(device: jax.Device | None = None) -> float:
+    d = device or jax.devices()[0]
+    kind = d.device_kind.lower()
+    for name, peak in PEAK_BF16_FLOPS.items():
+        if name in kind:
+            return peak
+    return PEAK_BF16_FLOPS["cpu"]
+
+
+@dataclass
+class StepTimer:
+    """Accumulates steps and wall time to report tokens/sec and MFU."""
+
+    flops_per_token: float
+    tokens_per_step: int
+    n_chips: int = 1
+    elapsed_s: float = 0.0
+    steps: int = 0
+
+    def record(self, dt_s: float, n_steps: int = 1) -> None:
+        self.elapsed_s += dt_s
+        self.steps += n_steps
+
+    @property
+    def tokens_per_sec(self) -> float:
+        if self.elapsed_s == 0:
+            return 0.0
+        return self.steps * self.tokens_per_step / self.elapsed_s
+
+    @property
+    def tokens_per_sec_per_chip(self) -> float:
+        return self.tokens_per_sec / self.n_chips
+
+    def mfu(self, peak_flops_per_chip: float | None = None) -> float:
+        peak = peak_flops_per_chip or chip_peak_flops()
+        return self.tokens_per_sec_per_chip * self.flops_per_token / peak

@@ -1,0 +1,96 @@
+"""Device-mesh construction: the ICI x DCN axis layout.
+
+The reference delegates all parallelism to user frameworks (SURVEY.md section 2
+"Parallelism strategies": TonY orchestrates NCCL/Gloo rings via env variables,
+implements none itself). Here the mesh is first-class: axes
+
+- ``dp``   -- pure data parallel (params replicated, grads psum'd)
+- ``fsdp`` -- data parallel with parameter/optimizer sharding (ZeRO-style)
+- ``tp``   -- tensor (Megatron-style) parallel over heads / ffn hidden
+- ``sp``   -- sequence/context parallel (ring attention over lax.ppermute)
+
+Collectives over these axes ride ICI within a slice; a multi-slice job maps its
+slice-crossing axis (usually ``dp``) onto DCN by putting it outermost, which is
+what ``mesh_utils.create_device_mesh`` produces for contiguous device order.
+Pipeline (``pp``) and expert (``ep``) axes are provided by
+tony_tpu.parallel.pipeline / .moe on top of the same mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis order: slice-crossing / outermost first.
+MESH_AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclass(frozen=True)
+class MeshShape:
+    """Per-axis sizes. Product must equal the number of devices used."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def sizes(self) -> tuple[int, int, int, int]:
+        return (self.dp, self.fsdp, self.tp, self.sp)
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.sizes)
+
+    def __post_init__(self) -> None:
+        for name, v in zip(MESH_AXES, self.sizes):
+            if v < 1:
+                raise ValueError(f"mesh axis {name!r} must be >= 1, got {v}")
+
+
+def default_shape(n_devices: int, *, tp: int = 1, sp: int = 1) -> MeshShape:
+    """FSDP-first default: all non-tp/sp parallelism goes to ``fsdp``.
+
+    FSDP is the right default on TPU (params sharded over ICI, all-gathered
+    per-layer: HBM-bound win) the way plain DP was the reference's Horovod
+    default.
+    """
+    if n_devices % (tp * sp):
+        raise ValueError(f"{n_devices} devices not divisible by tp*sp={tp * sp}")
+    return MeshShape(dp=1, fsdp=n_devices // (tp * sp), tp=tp, sp=sp)
+
+
+def build_mesh(shape: MeshShape | None = None, devices: list | None = None) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with the canonical axis names.
+
+    ``devices`` defaults to all local devices; shape defaults to
+    ``default_shape(len(devices))``. Uses ``mesh_utils.create_device_mesh`` so
+    that physically-near devices land on inner (tp/sp) axes -- inner axes carry
+    the latency-sensitive collectives and should ride the shortest ICI hops.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = default_shape(len(devices))
+    if shape.n_devices != len(devices):
+        raise ValueError(
+            f"mesh shape {shape.sizes} needs {shape.n_devices} devices, "
+            f"got {len(devices)}"
+        )
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape.sizes, devices=devices)
+    except (ValueError, AssertionError):
+        # Virtual/CPU device sets lack topology metadata; fall back to raveled order.
+        dev_array = np.asarray(devices).reshape(shape.sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh() -> Mesh:
+    """A 1x1x1x1 mesh over one device -- lets single-chip code share the
+    sharded code path (all PartitionSpecs collapse to replication)."""
+    return build_mesh(MeshShape(), devices=jax.devices()[:1])

@@ -1,0 +1,76 @@
+"""Logical-axis sharding rules.
+
+The scaling-book recipe: name every tensor dimension logically, map logical
+names to mesh axes with a rules table, and let XLA insert the collectives.
+Models annotate parameters with logical axis names (tuples of strings); this
+module turns those into ``NamedSharding``s for a concrete mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical dimension name -> mesh axis (or tuple of axes, or None = replicate)
+Rules = Mapping[str, str | tuple[str, ...] | None]
+
+# Default rules for a Megatron-sharded decoder transformer + FSDP:
+#   - "embed"  (model dim)        sharded over fsdp  (ZeRO-style param shard)
+#   - "heads"/"ffn" (wide dims)   sharded over tp
+#   - "vocab"  sharded over tp    (output projection column-parallel)
+#   - "batch"  over dp+fsdp, "seq" over sp (activations)
+DEFAULT_RULES: Rules = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "ffn": "tp",
+    "vocab": "tp",
+    "layers": None,
+    "head_dim": None,
+    "norm": None,
+}
+
+
+def spec_for(logical_axes: tuple[str | None, ...], rules: Rules = DEFAULT_RULES) -> P:
+    """Translate a tuple of logical axis names into a PartitionSpec."""
+    parts = []
+    used: set[str] = set()
+    for name in logical_axes:
+        axis = rules.get(name) if name is not None else None
+        # a mesh axis may appear at most once in a spec; later dims replicate
+        if axis is None:
+            parts.append(None)
+        elif isinstance(axis, tuple):
+            fresh = tuple(a for a in axis if a not in used)
+            used.update(fresh)
+            parts.append(fresh if fresh else None)
+        elif axis in used:
+            parts.append(None)
+        else:
+            used.add(axis)
+            parts.append(axis)
+    return P(*parts)
+
+
+def tree_shardings(
+    logical_tree: Any, mesh: Mesh, rules: Rules = DEFAULT_RULES
+) -> Any:
+    """Map a pytree of logical-axes tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, spec_for(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def tree_specs(logical_tree: Any, rules: Rules = DEFAULT_RULES) -> Any:
+    """Map a pytree of logical-axes tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: spec_for(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )

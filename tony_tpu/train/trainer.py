@@ -1,0 +1,143 @@
+"""Training-step construction: sharded init, jitted update, metrics.
+
+Replaces the reference's delegated data plane (Horovod allreduce / TF
+parameter servers, SURVEY.md section 2 "Distributed communication backend")
+with compiled XLA collectives: parameters and batch carry NamedShardings and
+XLA inserts the psum/all-gather/reduce-scatter pattern implied by the mesh --
+pure DP produces a gradient psum, FSDP produces reduce-scatter + all-gather,
+TP produces activation collectives, with zero framework code per strategy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu.models import llama
+from tony_tpu.parallel.sharding import DEFAULT_RULES, Rules, spec_for, tree_shardings
+
+Params = dict[str, Any]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    step: jax.Array
+    params: Params
+    opt_state: Any
+
+
+def default_optimizer(
+    lr: float = 3e-4, weight_decay: float = 0.1, warmup_steps: int = 100,
+    decay_steps: int = 10000, grad_clip: float = 1.0,
+) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps, max(decay_steps, warmup_steps + 1)
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        # mu_dtype pins the first moment to fp32 regardless of (typically
+        # bf16) param dtype; nu follows the params dtype in optax. Full
+        # mixed-precision (fp32 master params) is the train.precision
+        # module's job, not the optimizer's.
+        optax.adamw(
+            sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
+            mu_dtype=jnp.float32,
+        ),
+    )
+
+
+def state_shardings(
+    cfg: llama.LlamaConfig, mesh: Mesh, optimizer: optax.GradientTransformation,
+    rules: Rules = DEFAULT_RULES,
+) -> Any:
+    """Shardings for the full TrainState (optimizer state mirrors params).
+
+    Optimizer-state leaves are matched to parameters *structurally*: optax
+    states embed param-shaped pytrees (Adam mu/nu) whose key paths end with
+    the parameter's own path, so a path-suffix match recovers the exact
+    sharding even when distinct params share a shape (e.g. wq/wk/wv/wo are
+    all (L, 4096, 4096) in llama2_7b but shard differently). Scalar leaves
+    (step counts) replicate.
+    """
+    p_shard = tree_shardings(llama.logical_axes(cfg), mesh, rules)
+    params_shape = jax.eval_shape(partial(llama.init_params, cfg=cfg), jax.random.key(0))
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    replicated = NamedSharding(mesh, P())
+
+    param_paths, _ = jax.tree_util.tree_flatten_with_path(params_shape)
+    shard_leaves = jax.tree.leaves(p_shard)
+    by_path = {tuple(str(k) for k in path): s
+               for (path, _), s in zip(param_paths, shard_leaves)}
+    shape_by_path = {tuple(str(k) for k in path): leaf.shape
+                     for path, leaf in param_paths}
+
+    def opt_leaf_sharding(path: tuple, leaf: jax.ShapeDtypeStruct) -> NamedSharding:
+        keys = tuple(str(k) for k in path)
+        for plen in range(len(keys), 0, -1):
+            suffix = keys[-plen:]
+            if suffix in by_path and shape_by_path[suffix] == leaf.shape:
+                return by_path[suffix]
+        return replicated
+
+    o_shard = jax.tree_util.tree_map_with_path(opt_leaf_sharding, opt_shape)
+    return TrainState(step=replicated, params=p_shard, opt_state=o_shard)
+
+
+def make_train_state(
+    rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
+    optimizer: optax.GradientTransformation, rules: Rules = DEFAULT_RULES,
+) -> TrainState:
+    """Initialise the TrainState directly sharded (no host-side full copy --
+    required for models that don't fit one host/chip)."""
+    shardings = state_shardings(cfg, mesh, optimizer, rules)
+
+    def init(rng: jax.Array) -> TrainState:
+        params = llama.init_params(rng, cfg)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+        )
+
+    return jax.jit(init, out_shardings=shardings)(rng)
+
+
+def make_train_step(
+    cfg: llama.LlamaConfig, mesh: Mesh,
+    optimizer: optax.GradientTransformation, rules: Rules = DEFAULT_RULES,
+) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the jitted train step:
+    ``(state, inputs[B,S], targets[B,S]) -> (state, metrics)``.
+
+    Inputs/targets are pre-shifted next-token pairs (see
+    llama.loss_from_pairs) so the seq axis shards cleanly over ``sp``.
+    Gradients are computed in the params' dtype (Adam's first moment is kept
+    fp32 via mu_dtype); donation avoids a second copy of state.
+    """
+    shardings = state_shardings(cfg, mesh, optimizer, rules)
+    batch_sharding = NamedSharding(mesh, spec_for(("batch", "seq"), rules))
+    replicated = NamedSharding(mesh, P())
+
+    def step(state: TrainState, inputs: jax.Array, targets: jax.Array):
+        loss, grads = jax.value_and_grad(llama.loss_from_pairs)(
+            state.params, inputs, targets, cfg
+        )
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        metrics = {"loss": loss, "grad_norm": gnorm, "step": state.step + 1}
+        return TrainState(state.step + 1, new_params, new_opt), metrics
+
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sharding, batch_sharding),
+        out_shardings=(shardings, replicated),
+        donate_argnums=(0,),
+    )
