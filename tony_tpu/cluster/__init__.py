@@ -1,0 +1,34 @@
+"""Resource substrate: ClusterBackend protocol + implementations."""
+
+from tony_tpu.cluster.backend import (
+    ClusterBackend,
+    Container,
+    ContainerRequest,
+    ContainerState,
+    InsufficientResources,
+    Resource,
+)
+from tony_tpu.cluster.local import LocalProcessBackend
+from tony_tpu.cluster.tpu_vm import TpuVmBackend
+
+
+def make_backend(name: str, **kwargs) -> ClusterBackend:
+    """Backend factory keyed by the ``cluster.backend`` config value."""
+    if name == "local":
+        return LocalProcessBackend(**kwargs)
+    if name == "tpu_vm":
+        return TpuVmBackend(**kwargs)
+    raise ValueError(f"unknown cluster backend {name!r} (expected local | tpu_vm)")
+
+
+__all__ = [
+    "ClusterBackend",
+    "Container",
+    "ContainerRequest",
+    "ContainerState",
+    "InsufficientResources",
+    "LocalProcessBackend",
+    "Resource",
+    "TpuVmBackend",
+    "make_backend",
+]

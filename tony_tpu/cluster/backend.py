@@ -1,0 +1,168 @@
+"""Resource-manager abstraction: the YARN RM/NM analogue.
+
+The reference sits on Hadoop YARN: the AM calls ``AMRMClientAsync.
+addContainerRequest`` with (memory, vcores, yarn.io/gpu=n) and launches
+executors through ``NMClientAsync.startContainer`` (SURVEY.md sections 1, 3.1).
+There is no YARN here, so the substrate itself is a pluggable
+``ClusterBackend`` with a first-class ``tpu`` resource type (the
+``yarn.io/tpu`` analogue from BASELINE.json's north star). Two backends:
+
+- :class:`~tony_tpu.cluster.local.LocalProcessBackend` — containers are local
+  subprocesses against a fake inventory. This is both the dev/test substrate
+  (the tony-mini ``MiniCluster`` lesson, SURVEY.md section 4) and the
+  single-host production path.
+- :class:`~tony_tpu.cluster.tpu_vm.TpuVmBackend` — a documented stub mapping
+  the same protocol onto GCE TPU-VM pod-slice hosts (no cloud creds in the
+  image; gated behind NotImplementedError).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Protocol, Sequence
+
+
+class ContainerState(enum.Enum):
+    REQUESTED = "REQUESTED"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    RELEASED = "RELEASED"
+
+
+@dataclass(frozen=True)
+class Resource:
+    """A container-sized resource ask. ``tpu_chips`` is the yarn.io/tpu analogue."""
+
+    memory_mb: int = 2048
+    cpus: int = 1
+    tpu_chips: int = 0
+
+    def fits_in(self, other: "Resource") -> bool:
+        return (
+            self.memory_mb <= other.memory_mb
+            and self.cpus <= other.cpus
+            and self.tpu_chips <= other.tpu_chips
+        )
+
+    def __add__(self, other: "Resource") -> "Resource":
+        return Resource(
+            self.memory_mb + other.memory_mb,
+            self.cpus + other.cpus,
+            self.tpu_chips + other.tpu_chips,
+        )
+
+    def __sub__(self, other: "Resource") -> "Resource":
+        return Resource(
+            self.memory_mb - other.memory_mb,
+            self.cpus - other.cpus,
+            self.tpu_chips - other.tpu_chips,
+        )
+
+
+@dataclass(frozen=True)
+class ContainerRequest:
+    """One container ask from the AM's TaskScheduler."""
+
+    task_type: str
+    task_index: int
+    resource: Resource
+    argv: Sequence[str]             # executor launch command
+    env: Mapping[str, str] = field(default_factory=dict)
+    log_path: str = ""              # container stdout+stderr destination
+    node_label: str = ""            # placement hint (ignored by local backend)
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.task_type}:{self.task_index}"
+
+
+@dataclass
+class Container:
+    """A granted container. ``host`` feeds cluster-spec assembly."""
+
+    container_id: str
+    host: str
+    resource: Resource
+    request: ContainerRequest
+    state: ContainerState = ContainerState.RUNNING
+    exit_code: int | None = None
+
+
+# (container, exit_code) — fired from a backend thread when a container's
+# process exits on its own (not via release()).
+CompletionCallback = Callable[[Container, int], None]
+
+
+class ClusterBackend(Protocol):
+    """What the AM needs from a resource substrate.
+
+    Unlike YARN's async two-phase allocate (request -> callback), allocation
+    here is synchronous-or-raise: placement latency on local/TPU-VM substrates
+    is dominated by process start, not by queueing, so the gang wait moves to
+    the AM's registration barrier where it belongs.
+    """
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None:
+        """Release every container and shut down."""
+        ...
+
+    def total_capacity(self) -> Resource: ...
+
+    def available(self) -> Resource: ...
+
+    def allocate(self, request: ContainerRequest) -> Container:
+        """Grant + launch a container, or raise :class:`InsufficientResources`."""
+        ...
+
+    def release(self, container_id: str) -> None:
+        """Kill/release a container. No completion callback is fired."""
+        ...
+
+    def set_completion_callback(self, cb: CompletionCallback) -> None: ...
+
+
+class InsufficientResources(RuntimeError):
+    """The ask does not fit in the currently-available inventory."""
+
+
+class _InventoryMixin:
+    """Shared capacity bookkeeping for backends with a fixed inventory."""
+
+    def __init__(self, capacity: Resource):
+        self._capacity = capacity
+        self._in_use = Resource(0, 0, 0)
+        self._inv_lock = threading.Lock()
+
+    def total_capacity(self) -> Resource:
+        return self._capacity
+
+    def available(self) -> Resource:
+        with self._inv_lock:
+            return self._capacity - self._in_use
+
+    def _claim(self, r: Resource) -> None:
+        with self._inv_lock:
+            if not r.fits_in(self._capacity - self._in_use):
+                raise InsufficientResources(
+                    f"ask {r} exceeds available {self._capacity - self._in_use}"
+                )
+            self._in_use = self._in_use + r
+
+    def _reclaim(self, r: Resource) -> None:
+        with self._inv_lock:
+            self._in_use = self._in_use - r
+
+
+__all__ = [
+    "ClusterBackend",
+    "CompletionCallback",
+    "Container",
+    "ContainerRequest",
+    "ContainerState",
+    "InsufficientResources",
+    "Resource",
+]

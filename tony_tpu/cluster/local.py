@@ -1,0 +1,159 @@
+"""LocalProcessBackend: containers are local subprocesses.
+
+This is the tony-mini ``MiniCluster`` lesson (SURVEY.md section 4) promoted to
+a production backend: the resource substrate is faked at the infrastructure
+level (fixed inventory, subprocess "containers"), so every framework code path
+above it — AM scheduling, gang barrier, executor bootstrap, heartbeats,
+restart — is genuine.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import threading
+from typing import IO
+
+from tony_tpu.cluster.backend import (
+    CompletionCallback,
+    Container,
+    ContainerRequest,
+    ContainerState,
+    InsufficientResources,
+    Resource,
+    _InventoryMixin,
+)
+from tony_tpu.utils.net import local_host
+
+log = logging.getLogger(__name__)
+
+
+class LocalProcessBackend(_InventoryMixin):
+    """Subprocess containers against a fake, fixed inventory."""
+
+    def __init__(self, capacity: Resource | None = None):
+        super().__init__(capacity or Resource(memory_mb=1 << 20, cpus=256, tpu_chips=64))
+        self._containers: dict[str, Container] = {}
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._logs: dict[str, IO[bytes]] = {}
+        self._waiters: dict[str, threading.Thread] = {}
+        self._released: set[str] = set()
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._cb: CompletionCallback | None = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._stopped = False
+
+    def set_completion_callback(self, cb: CompletionCallback) -> None:
+        self._cb = cb
+
+    def allocate(self, request: ContainerRequest) -> Container:
+        if self._stopped:
+            raise InsufficientResources("backend stopped")
+        self._claim(request.resource)
+        try:
+            with self._lock:
+                self._next_id += 1
+                cid = f"container_{self._next_id:06d}"
+            env = dict(os.environ)
+            env.update(request.env)
+            env["TONY_CONTAINER_ID"] = cid
+            if request.log_path:
+                os.makedirs(os.path.dirname(request.log_path) or ".", exist_ok=True)
+                out: IO[bytes] = open(request.log_path, "ab")
+            else:
+                out = open(os.devnull, "ab")
+            # Own process group so release() can kill the executor together
+            # with the user training process it spawned.
+            proc = subprocess.Popen(
+                list(request.argv),
+                env=env,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        except Exception:
+            self._reclaim(request.resource)
+            raise
+        container = Container(
+            container_id=cid,
+            host=local_host(),
+            resource=request.resource,
+            request=request,
+            state=ContainerState.RUNNING,
+        )
+        with self._lock:
+            self._containers[cid] = container
+            self._procs[cid] = proc
+            self._logs[cid] = out
+        waiter = threading.Thread(target=self._wait, args=(cid,), daemon=True, name=f"wait-{cid}")
+        with self._lock:
+            self._waiters[cid] = waiter
+        waiter.start()
+        log.info("allocated %s for %s pid=%d", cid, request.task_id, proc.pid)
+        return container
+
+    def _wait(self, cid: str) -> None:
+        proc = self._procs[cid]
+        code = proc.wait()
+        with self._lock:
+            container = self._containers[cid]
+            released = cid in self._released
+            container.exit_code = code
+            container.state = (
+                ContainerState.RELEASED if released else ContainerState.COMPLETED
+            )
+            logf = self._logs.pop(cid, None)
+        if logf is not None:
+            try:
+                logf.close()
+            except OSError:
+                pass
+        self._reclaim(container.resource)
+        if not released and not self._stopped and self._cb is not None:
+            self._cb(container, code)
+
+    def release(self, container_id: str) -> None:
+        with self._lock:
+            proc = self._procs.get(container_id)
+            if proc is None or container_id in self._released:
+                return
+            self._released.add(container_id)
+        self._kill(proc)
+
+    @staticmethod
+    def _kill(proc: subprocess.Popen, grace_s: float = 3.0) -> None:
+        if proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        try:
+            proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def stop(self) -> None:
+        self._stopped = True
+        with self._lock:
+            cids = list(self._procs)
+            self._released.update(cids)
+        for cid in cids:
+            self._kill(self._procs[cid])
+        for cid, t in list(self._waiters.items()):
+            t.join(timeout=10)
+
+    def containers(self) -> list[Container]:
+        with self._lock:
+            return list(self._containers.values())
+
+
+__all__ = ["LocalProcessBackend"]

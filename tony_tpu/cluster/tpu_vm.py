@@ -1,0 +1,80 @@
+"""TpuVmBackend: pod-slice hosts as containers (documented stub).
+
+The north star (BASELINE.json) has the AM "allocate TPU-VM pod-slice hosts as
+YARN containers via a yarn.io/tpu resource type". On a real deployment each
+``Container`` maps to one TPU-VM worker host of a pod slice:
+
+- ``start()``        -> TPU API ``nodes.create`` (acceleratorType=v4-32 etc.)
+                        or attach to a pre-created slice; discover worker
+                        hostnames from instance metadata.
+- ``allocate(req)``  -> pick the next unassigned worker host; run the executor
+                        argv there over SSH with ``req.env`` exported
+                        (equivalent of NMClientAsync.startContainer).
+- ``release(cid)``   -> kill the remote process group.
+- completion         -> SSH channel exit status -> completion callback.
+- inventory          -> hosts x chips-per-host (v4: 4 chips/host).
+
+The slice topology is fixed — elastic restart is barrier-restart of the whole
+gang (SURVEY.md section 5 "failure detection"), which the AM implements above
+this layer; the backend only needs to re-launch on the same (or replacement)
+host.
+
+No cloud credentials or network exist in this image, so this backend raises on
+use; the protocol surface is kept identical to LocalProcessBackend so swapping
+backends is a config change (``cluster.backend = "tpu_vm"``).
+"""
+
+from __future__ import annotations
+
+from tony_tpu.cluster.backend import (
+    CompletionCallback,
+    Container,
+    ContainerRequest,
+    Resource,
+)
+
+
+class TpuVmBackend:
+    """Stub: same protocol as LocalProcessBackend, gated on cloud access."""
+
+    def __init__(
+        self,
+        accelerator_type: str = "v4-32",
+        chips_per_host: int = 4,
+        zone: str = "",
+        project: str = "",
+    ):
+        self.accelerator_type = accelerator_type
+        self.chips_per_host = chips_per_host
+        self.zone = zone
+        self.project = project
+
+    def _unavailable(self) -> RuntimeError:
+        return RuntimeError(
+            "TpuVmBackend requires Cloud TPU API access (none in this "
+            "environment); use cluster.backend = 'local'"
+        )
+
+    def start(self) -> None:
+        raise self._unavailable()
+
+    def stop(self) -> None:
+        pass
+
+    def total_capacity(self) -> Resource:
+        raise self._unavailable()
+
+    def available(self) -> Resource:
+        raise self._unavailable()
+
+    def allocate(self, request: ContainerRequest) -> Container:
+        raise self._unavailable()
+
+    def release(self, container_id: str) -> None:
+        raise self._unavailable()
+
+    def set_completion_callback(self, cb: CompletionCallback) -> None:
+        pass
+
+
+__all__ = ["TpuVmBackend"]

@@ -1,0 +1,20 @@
+"""Control-plane RPC: protobuf messages + gRPC service/client.
+
+See tony.proto for the protocol and service.py for the plumbing.
+"""
+
+from tony_tpu.rpc import tony_pb2 as pb
+from tony_tpu.rpc.service import (
+    SERVICE_NAME,
+    ApplicationRpcClient,
+    ApplicationRpcServicer,
+    serve,
+)
+
+__all__ = [
+    "ApplicationRpcClient",
+    "ApplicationRpcServicer",
+    "SERVICE_NAME",
+    "pb",
+    "serve",
+]

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Regenerate tony_pb2.py from tony.proto. The generated file is committed
+# because the image has protoc but not grpcio-tools; service stubs are
+# hand-written in service.py.
+set -e
+cd "$(dirname "$0")"
+protoc --python_out=. tony.proto

@@ -1,0 +1,236 @@
+"""gRPC service plumbing for the control plane.
+
+The reference exposes ``ApplicationRpc`` (registerWorkerSpec / getClusterSpec /
+taskExecutorHeartbeat / registerExecutionResult / registerTensorBoardUrl /
+getTaskInfos) and ``MetricsRpc`` as protobuf-over-Hadoop-RPC services
+(SURVEY.md section 2). Here both are folded into one gRPC service,
+``tony_tpu.ApplicationRpc``; stubs are hand-written against the generated
+message classes because the image ships protoc but not grpcio-tools.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Any, Callable
+
+import grpc
+
+from tony_tpu.rpc import tony_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME = "tony_tpu.ApplicationRpc"
+
+# method name -> (request class, response class). The single source of truth
+# for both server handler table and client stubs.
+_METHODS: dict[str, tuple[Any, Any]] = {
+    "RegisterWorkerSpec": (pb.RegisterWorkerSpecRequest, pb.RegisterWorkerSpecResponse),
+    "GetClusterSpec": (pb.GetClusterSpecRequest, pb.GetClusterSpecResponse),
+    "Heartbeat": (pb.HeartbeatRequest, pb.HeartbeatResponse),
+    "RegisterExecutionResult": (
+        pb.RegisterExecutionResultRequest,
+        pb.RegisterExecutionResultResponse,
+    ),
+    "RegisterTensorBoardUrl": (pb.RegisterTensorBoardUrlRequest, pb.Empty),
+    "PushMetrics": (pb.PushMetricsRequest, pb.Empty),
+    "GetTaskInfos": (pb.GetTaskInfosRequest, pb.GetTaskInfosResponse),
+    "GetApplicationStatus": (
+        pb.GetApplicationStatusRequest,
+        pb.GetApplicationStatusResponse,
+    ),
+    "StopApplication": (pb.StopApplicationRequest, pb.Empty),
+}
+
+
+class ApplicationRpcServicer:
+    """Override the methods you serve; unimplemented ones raise UNIMPLEMENTED."""
+
+    def RegisterWorkerSpec(self, request, context):  # noqa: N802 (rpc casing)
+        raise NotImplementedError
+
+    def GetClusterSpec(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def Heartbeat(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def RegisterExecutionResult(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def RegisterTensorBoardUrl(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def PushMetrics(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def GetTaskInfos(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def GetApplicationStatus(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def StopApplication(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+
+def _wrap(method: Callable[[Any, Any], Any]) -> Callable[[Any, Any], Any]:
+    def handler(request, context):
+        try:
+            return method(request, context)
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+        except Exception as e:  # surface servicer bugs to the caller
+            log.exception("rpc %s failed", method.__name__)
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+
+    return handler
+
+
+def serve(
+    servicer: ApplicationRpcServicer,
+    host: str = "0.0.0.0",
+    port: int = 0,
+    max_workers: int = 16,
+) -> tuple[grpc.Server, int]:
+    """Start the RPC server; returns (server, bound_port)."""
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            _wrap(getattr(servicer, name)),
+            request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString,
+        )
+        for name, (req, resp) in _METHODS.items()
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise RuntimeError(f"could not bind RPC port {host}:{port}")
+    server.start()
+    return server, bound
+
+
+class ApplicationRpcClient:
+    """Typed client for every control-plane method.
+
+    Used by executors (register/heartbeat/result/metrics) and by the CLI
+    (status/stop/task-infos) — the reference splits these across
+    ApplicationRpcClient and YARN report polling; here the AM answers both.
+    """
+
+    def __init__(self, address: str, timeout_s: float = 10.0):
+        self.address = address
+        self.timeout_s = timeout_s
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.enable_retries", 1),
+                ("grpc.keepalive_time_ms", 30000),
+            ],
+        )
+        for name, (req, resp) in _METHODS.items():
+            stub = self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+            setattr(self, f"_stub_{name}", stub)
+
+    def _call(self, name: str, request, timeout_s: float | None = None):
+        stub = getattr(self, f"_stub_{name}")
+        return stub(request, timeout=timeout_s or self.timeout_s)
+
+    # --- executor-side ---
+    def register_worker_spec(
+        self,
+        job_name: str,
+        index: int,
+        host: str,
+        port: int,
+        attempt: int = 0,
+        container_id: str = "",
+    ) -> pb.RegisterWorkerSpecResponse:
+        return self._call(
+            "RegisterWorkerSpec",
+            pb.RegisterWorkerSpecRequest(
+                job_name=job_name,
+                index=index,
+                host=host,
+                port=port,
+                attempt=attempt,
+                container_id=container_id,
+            ),
+        )
+
+    def get_cluster_spec(self, job_name: str, index: int) -> pb.GetClusterSpecResponse:
+        return self._call(
+            "GetClusterSpec", pb.GetClusterSpecRequest(job_name=job_name, index=index)
+        )
+
+    def heartbeat(self, job_name: str, index: int, attempt: int = 0) -> pb.HeartbeatResponse:
+        return self._call(
+            "Heartbeat",
+            pb.HeartbeatRequest(job_name=job_name, index=index, attempt=attempt),
+        )
+
+    def register_execution_result(
+        self, job_name: str, index: int, exit_code: int, message: str = "", attempt: int = 0
+    ) -> pb.RegisterExecutionResultResponse:
+        return self._call(
+            "RegisterExecutionResult",
+            pb.RegisterExecutionResultRequest(
+                job_name=job_name,
+                index=index,
+                exit_code=exit_code,
+                message=message,
+                attempt=attempt,
+            ),
+        )
+
+    def register_tensorboard_url(self, url: str) -> None:
+        self._call("RegisterTensorBoardUrl", pb.RegisterTensorBoardUrlRequest(url=url))
+
+    def push_metrics(
+        self, job_name: str, index: int, samples: list[tuple[str, float, float]]
+    ) -> None:
+        self._call(
+            "PushMetrics",
+            pb.PushMetricsRequest(
+                job_name=job_name,
+                index=index,
+                samples=[
+                    pb.MetricSample(name=n, value=v, timestamp=ts)
+                    for n, v, ts in samples
+                ],
+            ),
+        )
+
+    # --- client-side ---
+    def get_task_infos(self) -> pb.GetTaskInfosResponse:
+        return self._call("GetTaskInfos", pb.GetTaskInfosRequest())
+
+    def get_application_status(self) -> pb.GetApplicationStatusResponse:
+        return self._call("GetApplicationStatus", pb.GetApplicationStatusRequest())
+
+    def stop_application(self, reason: str = "") -> None:
+        self._call("StopApplication", pb.StopApplicationRequest(reason=reason))
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "ApplicationRpcClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+__all__ = [
+    "ApplicationRpcClient",
+    "ApplicationRpcServicer",
+    "SERVICE_NAME",
+    "serve",
+]
