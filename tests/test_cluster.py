@@ -1,0 +1,163 @@
+"""Tests for the local cluster backend and scheduler."""
+
+import sys
+import time
+
+import pytest
+
+from tony_tpu.am.scheduler import (
+    AllocationTimeout,
+    DependencyTimeout,
+    SchedulerHooks,
+    TaskScheduler,
+)
+from tony_tpu.am.session import Session, TaskState
+from tony_tpu.cluster import (
+    ContainerRequest,
+    InsufficientResources,
+    LocalProcessBackend,
+    Resource,
+)
+from tony_tpu.config.config import TaskTypeSpec
+
+
+def wait_for(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def req(task_type="worker", index=0, argv=None, mem=64):
+    return ContainerRequest(
+        task_type=task_type,
+        task_index=index,
+        resource=Resource(mem, 1, 0),
+        argv=argv or [sys.executable, "-c", "pass"],
+    )
+
+
+class TestLocalProcessBackend:
+    def test_completion_callback_and_reclaim(self):
+        done = []
+        b = LocalProcessBackend(capacity=Resource(1024, 8, 0))
+        b.set_completion_callback(lambda c, code: done.append((c.request.task_id, code)))
+        b.start()
+        b.allocate(req(argv=[sys.executable, "-c", "raise SystemExit(3)"]))
+        assert wait_for(lambda: done == [("worker:0", 3)])
+        assert b.available().memory_mb == 1024
+        b.stop()
+
+    def test_insufficient_resources(self):
+        b = LocalProcessBackend(capacity=Resource(100, 1, 0))
+        b.start()
+        with pytest.raises(InsufficientResources):
+            b.allocate(req(mem=200))
+        b.stop()
+
+    def test_release_kills_without_callback(self):
+        done = []
+        b = LocalProcessBackend(capacity=Resource(1024, 8, 0))
+        b.set_completion_callback(lambda c, code: done.append(code))
+        b.start()
+        c = b.allocate(req(argv=[sys.executable, "-c", "import time; time.sleep(60)"]))
+        b.release(c.container_id)
+        assert wait_for(lambda: b.available().memory_mb == 1024)
+        time.sleep(0.2)
+        assert done == []  # released containers fire no completion
+        b.stop()
+
+    def test_tpu_resource_accounting(self):
+        b = LocalProcessBackend(capacity=Resource(1024, 8, 4))
+        b.start()
+        r = ContainerRequest("w", 0, Resource(64, 1, 4),
+                             [sys.executable, "-c", "import time; time.sleep(30)"])
+        c = b.allocate(r)
+        assert b.available().tpu_chips == 0
+        with pytest.raises(InsufficientResources):
+            b.allocate(ContainerRequest("w", 1, Resource(64, 1, 1), ["true"]))
+        b.release(c.container_id)
+        assert wait_for(lambda: b.available().tpu_chips == 4)
+        b.stop()
+
+
+def make_sched(specs, capacity=Resource(1 << 16, 64, 0), timeout=5.0):
+    session = Session(specs)
+    backend = LocalProcessBackend(capacity=capacity)
+    backend.start()
+
+    def make_request(spec, index):
+        return ContainerRequest(
+            spec.name, index, Resource(spec.memory_mb, spec.cpus, spec.tpu_chips),
+            [sys.executable, "-c", "import time; time.sleep(30)"],
+        )
+
+    sched = TaskScheduler(
+        session, backend, SchedulerHooks(make_request, lambda *a: None),
+        allocation_timeout_s=timeout, poll_interval_s=0.05,
+    )
+    return session, backend, sched
+
+
+class TestTaskScheduler:
+    def test_allocates_all(self):
+        specs = {"worker": TaskTypeSpec(name="worker", instances=3, memory_mb=64)}
+        session, backend, sched = make_sched(specs)
+        sched.schedule_all(specs)
+        assert all(t.state == TaskState.ALLOCATED for t in session.tasks.values())
+        backend.stop()
+
+    def test_dependency_gates_launch(self):
+        specs = {
+            "ps": TaskTypeSpec(name="ps", instances=1, memory_mb=64),
+            "worker": TaskTypeSpec(
+                name="worker", instances=1, memory_mb=64, depends_on="ps",
+                depends_timeout_s=10,
+            ),
+        }
+        session, backend, sched = make_sched(specs)
+        import threading
+
+        t = threading.Thread(target=sched.schedule_all, args=(specs,), daemon=True)
+        t.start()
+        assert wait_for(lambda: session.task("ps", 0).state == TaskState.ALLOCATED)
+        time.sleep(0.3)
+        # worker must wait: ps allocated but not REGISTERED yet
+        assert session.task("worker", 0).state == TaskState.PENDING
+        session.register("ps", 0, "h", 1, 0)
+        assert wait_for(lambda: session.task("worker", 0).state == TaskState.ALLOCATED)
+        t.join(timeout=5)
+        backend.stop()
+
+    def test_dependency_timeout(self):
+        specs = {
+            "ps": TaskTypeSpec(name="ps", instances=1, memory_mb=64),
+            "worker": TaskTypeSpec(
+                name="worker", instances=1, memory_mb=64, depends_on="ps",
+                depends_timeout_s=1,
+            ),
+        }
+        _, backend, sched = make_sched(specs, timeout=30.0)
+        with pytest.raises(DependencyTimeout):
+            sched.schedule_all(specs)  # ps never registers
+        backend.stop()
+
+    def test_capacity_check_upfront(self):
+        specs = {"worker": TaskTypeSpec(name="worker", instances=4, memory_mb=64)}
+        _, backend, sched = make_sched(specs, capacity=Resource(128, 64, 0))
+        with pytest.raises(InsufficientResources):
+            sched.schedule_all(specs)
+        backend.stop()
+
+    def test_allocation_timeout_when_inventory_held(self):
+        # total fits capacity but a zombie holds half: allocation times out
+        specs = {"worker": TaskTypeSpec(name="worker", instances=2, memory_mb=64)}
+        session, backend, sched = make_sched(
+            specs, capacity=Resource(192, 64, 0), timeout=1.0
+        )
+        backend.allocate(req("zombie", 0, [sys.executable, "-c", "import time; time.sleep(30)"], mem=128))
+        with pytest.raises(AllocationTimeout):
+            sched.schedule_all(specs)
+        backend.stop()

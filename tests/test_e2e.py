@@ -1,0 +1,242 @@
+"""End-to-end tests: real client -> AM -> executors -> user processes.
+
+The keystone suite, modelled on the reference's TestTonyE2E (SURVEY.md
+section 4): the substrate is faked at the infrastructure level (local
+subprocess containers), so every framework code path — submission, gang
+barrier, cluster spec, runtimes, heartbeats, failure policy, elastic
+restart — is genuine.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from tony_tpu.am.events import read_history
+from tony_tpu.cli.client import TonyClient
+from tony_tpu.config.config import TonyConfig
+
+FAST = {
+    "task.heartbeat_interval_ms": 200,
+    "task.max_missed_heartbeats": 10,
+    "application.timeout_s": 90,
+}
+
+
+def submit(tmp_path, overrides, src_dir=""):
+    cfg = TonyConfig.load(
+        overrides={**FAST, "application.stage_dir": str(tmp_path), **overrides}
+    )
+    client = TonyClient(cfg, src_dir=src_dir)
+    code = client.run(quiet=True)
+    return code, client.app_dir
+
+
+def read_status(app_dir):
+    with open(os.path.join(app_dir, "status.json")) as f:
+        return json.load(f)
+
+
+def events_of(app_dir, app_id=None):
+    ev_dir = os.path.join(app_dir, "events")
+    files = [f for f in os.listdir(ev_dir) if f.endswith(".jsonl")]
+    assert len(files) == 1
+    return read_history(os.path.join(ev_dir, files[0]))
+
+
+def test_two_workers_succeed(tmp_path):
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "ok",
+            "application.framework": "generic",
+            "job.worker.instances": 2,
+            "job.worker.command": (
+                'python -c "import os, json; '
+                "spec = json.loads(os.environ['TONY_CLUSTER_SPEC']); "
+                'assert len(spec[\'worker\']) == 2"'
+            ),
+        },
+    )
+    assert code == 0
+    status = read_status(app_dir)
+    assert status["state"] == "SUCCEEDED"
+    types = [e["type"] for e in events_of(app_dir)]
+    assert types[0] == "APPLICATION_INITED"
+    assert types[-1] == "APPLICATION_FINISHED"
+    assert types.count("TASK_FINISHED") == 2
+
+
+def test_failure_propagates_exit_code(tmp_path):
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "fail",
+            "application.framework": "generic",
+            "job.worker.instances": 2,
+            "job.worker.command": (
+                "python -c \"import os, sys; "
+                "sys.exit(7 if os.environ['TONY_TASK_INDEX'] == '1' else 0)\""
+            ),
+        },
+    )
+    assert code == 7
+    assert read_status(app_dir)["state"] == "FAILED"
+
+
+def test_untracked_type_never_fails_job(tmp_path):
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "untracked",
+            "application.framework": "generic",
+            "job.worker.instances": 1,
+            "job.worker.command": 'python -c "pass"',
+            "job.tensorboard.instances": 1,
+            "job.tensorboard.untracked": True,
+            # sleeps forever; AM must finish the job and reap it anyway
+            "job.tensorboard.command": 'python -c "import time; time.sleep(600)"',
+        },
+    )
+    assert code == 0
+    assert read_status(app_dir)["state"] == "SUCCEEDED"
+
+
+def test_ps_worker_dependency_tf_runtime(tmp_path):
+    """PS+worker shape (milestone config #2): FCFS mode, TF_CONFIG contract."""
+    check = (
+        'python -c "import os, json; tf = json.loads(os.environ[\'TF_CONFIG\']); '
+        "assert set(tf['cluster']) == {'ps', 'worker'}; "
+        "assert tf['task']['type'] == os.environ['TONY_JOB_NAME']\""
+    )
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "psworker",
+            "application.framework": "tensorflow",
+            "scheduler.mode": "FCFS",
+            "job.ps.instances": 1,
+            "job.ps.command": check,
+            "job.worker.instances": 2,
+            "job.worker.depends_on": "ps",
+            "job.worker.depends_timeout_s": 30,
+            "job.worker.command": check,
+        },
+    )
+    assert code == 0
+
+
+def test_worker_restart_failed_only(tmp_path):
+    """Elastic path (milestone config #5 shape): fail once, restart, succeed."""
+    marker = tmp_path / "attempt.marker"
+    script = (
+        f'python -c "import os, sys; p = {str(marker)!r}; '
+        "first = not os.path.exists(p); "
+        "open(p, 'a').write('x'); "
+        'sys.exit(1 if first else 0)"'
+    )
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "restart",
+            "application.framework": "generic",
+            "restart.policy": "failed_only",
+            "restart.max_worker_restarts": 2,
+            "job.worker.instances": 1,
+            "job.worker.command": script,
+        },
+    )
+    assert code == 0
+    status = read_status(app_dir)
+    assert status["state"] == "SUCCEEDED"
+    worker = next(t for t in status["tasks"] if t["task"] == "worker:0")
+    assert worker["attempts"] == 2
+
+
+def test_gang_restart_restarts_all_workers(tmp_path):
+    """Barrier-restart: one worker's failure restarts the whole gang."""
+    marker = tmp_path / "gang.marker"
+    # worker 0 fails on the first attempt; worker 1 sleeps long enough to be
+    # killed by the gang restart, then both succeed on attempt 1.
+    script = (
+        f'python -c "import os, sys, time; p = {str(marker)!r}; '
+        "idx = os.environ['TONY_TASK_INDEX']; "
+        "first = not os.path.exists(p); "
+        "(open(p, 'a').write('x'), sys.exit(1)) if (first and idx == '0') "
+        "else time.sleep(3 if first else 0)\""
+    )
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "gang",
+            "application.framework": "generic",
+            "restart.policy": "gang",
+            "restart.max_worker_restarts": 2,
+            "job.worker.instances": 2,
+            "job.worker.command": script,
+        },
+    )
+    assert code == 0
+    status = read_status(app_dir)
+    assert status["state"] == "SUCCEEDED"
+    assert all(t["attempts"] == 2 for t in status["tasks"])
+    assert any(e["type"] == "GANG_RESTART" for e in events_of(app_dir))
+
+
+def test_executor_crash_detected_via_container_exit(tmp_path):
+    """User script SIGKILLs its executor: the container-completion backup
+    path must mark the task failed (no result RPC ever arrives)."""
+    script = (
+        'python -c "import os, signal, time; '
+        'os.kill(os.getppid(), signal.SIGKILL); time.sleep(30)"'
+    )
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "crash",
+            "application.framework": "generic",
+            "job.worker.instances": 1,
+            "job.worker.command": script,
+        },
+    )
+    assert code != 0
+    assert read_status(app_dir)["state"] == "FAILED"
+
+
+@pytest.mark.slow
+def test_jax_distributed_two_process_reduction(tmp_path):
+    """Milestone config #4 skeleton: 2-process jax.distributed DP on the CPU
+    backend — cross-process global reduction through the real gang barrier."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "train.py").write_text(
+        "import tony_tpu.runtime.jax_tpu as rt\n"
+        "rt.initialize()\n"
+        "import jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "assert jax.process_count() == 2, jax.process_count()\n"
+        "mesh = Mesh(jax.devices(), ('dp',))\n"
+        "x = jax.make_array_from_process_local_data(\n"
+        "    NamedSharding(mesh, P('dp')),\n"
+        "    jnp.ones((len(jax.devices()) // 2,), jnp.float32))\n"
+        "total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)\n"
+        "assert float(total) == len(jax.devices())\n"
+        f"print('rank', jax.process_index(), 'reduction ok')\n"
+    )
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "jaxdp",
+            "application.framework": "jax",
+            "application.timeout_s": 150,
+            "job.worker.instances": 2,
+            "job.worker.command": f"{sys.executable} train.py",
+        },
+        src_dir=str(src),
+    )
+    if code != 0:
+        logs_dir = os.path.join(app_dir, "logs")
+        for n in sorted(os.listdir(logs_dir)):
+            print(f"===== {n}", open(os.path.join(logs_dir, n), errors="replace").read()[-2000:])
+    assert code == 0

@@ -1,0 +1,87 @@
+"""Round-trip tests for the gRPC control plane."""
+
+import threading
+
+import grpc
+import pytest
+
+from tony_tpu.rpc import ApplicationRpcClient, ApplicationRpcServicer, pb, serve
+
+
+class EchoServicer(ApplicationRpcServicer):
+    def __init__(self):
+        self.registered = []
+        self.results = []
+        self.metrics = []
+        self.lock = threading.Lock()
+
+    def RegisterWorkerSpec(self, request, context):
+        with self.lock:
+            self.registered.append((request.job_name, request.index, request.host, request.port))
+        return pb.RegisterWorkerSpecResponse(accepted=True)
+
+    def GetClusterSpec(self, request, context):
+        return pb.GetClusterSpecResponse(
+            ready=True,
+            spec_json='{"worker": ["h:1"]}',
+            coordinator_address="h:1",
+            process_id=request.index,
+            num_processes=2,
+            generation=3,
+        )
+
+    def Heartbeat(self, request, context):
+        return pb.HeartbeatResponse(action=pb.HeartbeatResponse.NONE)
+
+    def RegisterExecutionResult(self, request, context):
+        with self.lock:
+            self.results.append((request.job_name, request.index, request.exit_code))
+        return pb.RegisterExecutionResultResponse(acknowledged=True)
+
+    def PushMetrics(self, request, context):
+        with self.lock:
+            self.metrics.extend((s.name, s.value) for s in request.samples)
+        return pb.Empty()
+
+    def GetApplicationStatus(self, request, context):
+        return pb.GetApplicationStatusResponse(state="RUNNING", exit_code=0)
+
+
+@pytest.fixture
+def rpc_pair():
+    servicer = EchoServicer()
+    server, port = serve(servicer, port=0)
+    client = ApplicationRpcClient(f"127.0.0.1:{port}")
+    yield servicer, client
+    client.close()
+    server.stop(0)
+
+
+def test_register_and_spec_roundtrip(rpc_pair):
+    servicer, client = rpc_pair
+    resp = client.register_worker_spec("worker", 1, "myhost", 4242)
+    assert resp.accepted
+    assert servicer.registered == [("worker", 1, "myhost", 4242)]
+    spec = client.get_cluster_spec("worker", 1)
+    assert spec.ready and spec.process_id == 1 and spec.num_processes == 2
+    assert spec.generation == 3
+
+
+def test_result_and_metrics(rpc_pair):
+    servicer, client = rpc_pair
+    client.register_execution_result("worker", 0, 7, message="boom")
+    assert servicer.results == [("worker", 0, 7)]
+    client.push_metrics("worker", 0, [("cpu_percent", 55.5, 123.0)])
+    assert servicer.metrics == [("cpu_percent", 55.5)]
+
+
+def test_heartbeat_and_status(rpc_pair):
+    _, client = rpc_pair
+    assert client.heartbeat("worker", 0).action == pb.HeartbeatResponse.NONE
+    assert client.get_application_status().state == "RUNNING"
+
+
+def test_unimplemented_method_raises(rpc_pair):
+    _, client = rpc_pair
+    with pytest.raises(grpc.RpcError):
+        client.get_task_infos()

@@ -1,0 +1,84 @@
+"""Env-contract tests for the framework runtime adapters.
+
+Parity definition per SURVEY.md section 7 hard part #4: env-contract +
+lifecycle equivalence with the reference's TFRuntime / PyTorchRuntime /
+HorovodRuntime, with JaxTpuRuntime as the first-class TPU path.
+"""
+
+import json
+
+import pytest
+
+from tony_tpu.config.config import TonyConfig
+from tony_tpu.runtime import TaskIdentity, make_runtime
+
+
+@pytest.fixture
+def identity():
+    return TaskIdentity(
+        job_name="worker",
+        index=1,
+        cluster_spec={"ps": ["h0:2000"], "worker": ["h1:2001", "h2:2002"]},
+        coordinator_address="h0:2000",
+        process_id=2,
+        num_processes=3,
+        generation=1,
+    )
+
+
+def test_generic_runtime_base_env(identity):
+    env = make_runtime("generic").build_env(identity, TonyConfig())
+    spec = json.loads(env["TONY_CLUSTER_SPEC"])
+    assert spec["worker"] == ["h1:2001", "h2:2002"]
+    assert env["TONY_PROCESS_ID"] == "2"
+    assert env["TONY_NUM_PROCESSES"] == "3"
+    assert env["TONY_COORDINATOR_ADDR"] == "h0:2000"
+
+
+def test_tf_config_contract(identity):
+    env = make_runtime("tensorflow").build_env(identity, TonyConfig())
+    tf_config = json.loads(env["TF_CONFIG"])
+    assert tf_config["cluster"] == {
+        "ps": ["h0:2000"],
+        "worker": ["h1:2001", "h2:2002"],
+    }
+    assert tf_config["task"] == {"type": "worker", "index": 1}
+
+
+def test_pytorch_contract(identity):
+    env = make_runtime("pytorch").build_env(identity, TonyConfig())
+    assert env["MASTER_ADDR"] == "h0"
+    assert env["MASTER_PORT"] == "2000"
+    assert env["RANK"] == "2"
+    assert env["WORLD_SIZE"] == "3"
+    assert env["LOCAL_RANK"] == "0"
+
+
+def test_horovod_contract(identity):
+    env = make_runtime("horovod").build_env(identity, TonyConfig())
+    assert env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] == "h0"
+    assert env["HOROVOD_GLOO_RENDEZVOUS_PORT"] == "2000"
+    assert env["HOROVOD_RANK"] == "2"
+    assert env["HOROVOD_SIZE"] == "3"
+    assert env["HOROVOD_LOCAL_SIZE"] == "1"
+    assert env["HOROVOD_CONTROLLER"] == "gloo"
+
+
+def test_jax_contract(identity):
+    env = make_runtime("jax").build_env(identity, TonyConfig())
+    assert env["JAX_COORDINATOR_ADDRESS"] == "h0:2000"
+    assert env["JAX_PROCESS_ID"] == "2"
+    assert env["JAX_NUM_PROCESSES"] == "3"
+
+
+def test_unknown_framework_rejected():
+    with pytest.raises(ValueError):
+        make_runtime("mxnet-nope")
+
+
+def test_jax_initialize_noop_outside_job(monkeypatch):
+    from tony_tpu.runtime import jax_tpu
+
+    monkeypatch.delenv(jax_tpu.ENV_COORDINATOR, raising=False)
+    jax_tpu.initialize()  # must not raise or touch jax.distributed
+    assert not jax_tpu.in_tony_job()

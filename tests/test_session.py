@@ -1,0 +1,96 @@
+"""Unit tests for the AM session state machine (TonySession analogue)."""
+
+from tony_tpu.am.session import JobState, Session, TaskState
+from tony_tpu.config.config import TaskTypeSpec
+
+
+def make_specs(**kwargs) -> dict[str, TaskTypeSpec]:
+    out = {}
+    for name, n in kwargs.items():
+        untracked = name.startswith("tb")
+        out[name] = TaskTypeSpec(name=name, instances=n, untracked=untracked)
+    return out
+
+
+def test_task_table_and_registration():
+    s = Session(make_specs(worker=2, ps=1))
+    assert len(s.tasks) == 3
+    assert not s.all_registered()
+    assert s.register("worker", 0, "h1", 1000, attempt=0)
+    assert s.register("worker", 1, "h2", 1001, attempt=0)
+    assert not s.all_registered()
+    assert s.register("ps", 0, "h3", 1002, attempt=0)
+    assert s.all_registered()
+    # unknown task / stale attempt rejected
+    assert not s.register("worker", 5, "h", 1, attempt=0)
+    assert not s.register("worker", 0, "h", 1, attempt=3)
+
+
+def test_cluster_spec_json_shape():
+    s = Session(make_specs(worker=2, ps=1))
+    s.register("worker", 0, "h1", 1000, 0)
+    s.register("worker", 1, "h2", 1001, 0)
+    s.register("ps", 0, "h3", 1002, 0)
+    import json
+
+    spec = json.loads(s.cluster_spec_json())
+    assert spec == {"worker": ["h1:1000", "h2:1001"], "ps": ["h3:1002"]}
+
+
+def test_rank_table_deterministic_and_excludes_untracked():
+    s = Session(make_specs(worker=2, ps=1, tb=1))
+    table = s.rank_table()
+    # sorted type order: ps < tb(excluded) < worker
+    assert table == {"ps:0": 0, "worker:0": 1, "worker:1": 2}
+    s.register("ps", 0, "h", 1, 0)
+    assert s.coordinator_task().task_id == "ps:0"
+
+
+def test_final_status_untracked_never_fails_job():
+    s = Session(make_specs(worker=1, tb=1))
+    s.on_task_completed("worker", 0, 0)
+    s.on_task_completed("tb", 0, 137)
+    assert s.job_done()
+    state, code = s.final_status()
+    assert state == JobState.SUCCEEDED and code == 0
+
+
+def test_final_status_propagates_failure_code():
+    s = Session(make_specs(worker=2))
+    s.on_task_completed("worker", 0, 0)
+    s.on_task_completed("worker", 1, 7)
+    state, code = s.final_status()
+    assert state == JobState.FAILED and code == 7
+
+
+def test_chief_semantics():
+    s = Session(make_specs(chief=1, worker=2), chief_type="chief")
+    s.on_task_completed("chief", 0, 0)
+    # workers still running, but chief done -> job done & succeeded
+    assert s.job_done()
+    state, code = s.final_status()
+    assert state == JobState.SUCCEEDED and code == 0
+
+
+def test_gang_reset_bumps_attempts_and_generation():
+    s = Session(make_specs(worker=2))
+    s.register("worker", 0, "h", 1, 0)
+    s.on_task_completed("worker", 1, 1)
+    reset = s.reset_for_restart(None)
+    assert len(reset) == 2
+    assert s.generation == 1
+    for t in s.tasks.values():
+        assert t.state == TaskState.PENDING
+        assert t.attempt == 1
+        assert t.host == "" and t.exit_code is None
+    # old-attempt registration now rejected
+    assert not s.register("worker", 0, "h", 1, attempt=0)
+    assert s.register("worker", 0, "h", 1, attempt=1)
+
+
+def test_partial_reset_only_named_types():
+    s = Session(make_specs(worker=2, ps=1))
+    s.on_task_completed("worker", 0, 1)
+    reset = s.reset_for_restart({"worker"})
+    assert {t.task_id for t in reset} == {"worker:0", "worker:1"}
+    assert s.task("ps", 0).attempt == 0

@@ -1,0 +1,24 @@
+"""ApplicationMaster: session state, scheduling, supervision, history."""
+
+from tony_tpu.am.events import EventType, EventWriter, read_history
+from tony_tpu.am.scheduler import (
+    AllocationTimeout,
+    DependencyTimeout,
+    SchedulerHooks,
+    TaskScheduler,
+)
+from tony_tpu.am.session import JobState, Session, Task, TaskState
+
+__all__ = [
+    "AllocationTimeout",
+    "DependencyTimeout",
+    "EventType",
+    "EventWriter",
+    "JobState",
+    "SchedulerHooks",
+    "Session",
+    "Task",
+    "TaskScheduler",
+    "TaskState",
+    "read_history",
+]

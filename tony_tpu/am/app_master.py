@@ -1,0 +1,493 @@
+"""ApplicationMaster: the brain of a job.
+
+Rebuild of the reference's ``TonyApplicationMaster`` (SURVEY.md sections 2,
+3.1, 3.3): registers with the resource substrate, requests containers per task
+type, launches executors, runs the control-plane RPC server, assembles the
+cluster spec after all registrations (gang semantics), supervises heartbeats,
+applies the failure/retry policy including the elastic worker-restart path,
+emits history events, and reports final status.
+
+Threading discipline (the survey flags AM state races as "the bug farm",
+section 7 hard part #2): RPC handlers and backend callbacks never apply
+failure policy themselves — they update the Session table (internally locked)
+and enqueue notifications; the single main supervision loop makes every
+life-cycle decision (restart / fail / finish).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import sys
+import threading
+import time
+from typing import Any
+
+from tony_tpu.am.events import EventType, EventWriter
+from tony_tpu.am.scheduler import SchedulerHooks, TaskScheduler
+from tony_tpu.am.session import JobState, Session, TaskState, TERMINAL
+from tony_tpu.cluster import make_backend
+from tony_tpu.cluster.backend import Container, ContainerRequest, Resource
+from tony_tpu.config.config import TaskTypeSpec, TonyConfig
+from tony_tpu.config.keys import Keys
+from tony_tpu.rpc import ApplicationRpcServicer, pb, serve
+
+log = logging.getLogger(__name__)
+
+
+class ApplicationMaster(ApplicationRpcServicer):
+    """One instance per job. ``run()`` blocks until the job is terminal."""
+
+    def __init__(self, config: TonyConfig, app_id: str, app_dir: str):
+        self.config = config
+        self.app_id = app_id
+        self.app_dir = app_dir
+        self.specs: dict[str, TaskTypeSpec] = config.task_specs()
+        if not self.specs:
+            raise ValueError("no job types configured (need job.<type>.instances)")
+        chief = "chief" if "chief" in self.specs else ""
+        self.session = Session(self.specs, chief_type=chief)
+        self.backend = make_backend(config.get_str(Keys.CLUSTER_BACKEND, "local"))
+        self.events = EventWriter(
+            app_id,
+            config.get_str(Keys.HISTORY_INTERMEDIATE_DIR)
+            or os.path.join(app_dir, "events"),
+            config.get_str(Keys.HISTORY_FINISHED_DIR),
+        )
+        self.scheduler = TaskScheduler(
+            self.session,
+            self.backend,
+            SchedulerHooks(self._make_request, self._on_allocated),
+            allocation_timeout_s=config.get_float(Keys.AM_ALLOCATION_TIMEOUT_S, 300.0),
+        )
+        self._notifications: queue.Queue[tuple[str, Any]] = queue.Queue()
+        self._server = None
+        self.port = 0
+        self._killed = threading.Event()
+        self._heartbeat_interval_s = config.get_int(Keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
+        self._max_missed = config.get_int(Keys.TASK_MAX_MISSED_HEARTBEATS, 25)
+        self._restart_policy = config.get_str(Keys.RESTART_POLICY, "never")
+        self._max_restarts = config.get_int(Keys.RESTART_MAX_WORKER_RESTARTS, 0)
+        self._latest_metrics: dict[str, dict[str, float]] = {}
+        self._scheduler_mode = config.get_str(Keys.SCHEDULER_MODE, "GANG").upper()
+
+    # --- executor launch ----------------------------------------------------
+
+    def _make_request(self, spec: TaskTypeSpec, index: int) -> ContainerRequest:
+        task = self.session.task(spec.name, index)
+        attempt = task.attempt if task else 0
+        python = self.config.get_str(Keys.TASK_EXECUTOR_PYTHON) or sys.executable
+        env = {
+            "TONY_APP_ID": self.app_id,
+            "TONY_APP_DIR": self.app_dir,
+            "TONY_JOB_NAME": spec.name,
+            "TONY_TASK_INDEX": str(index),
+            "TONY_ATTEMPT": str(attempt),
+            "TONY_AM_ADDR": f"127.0.0.1:{self.port}",
+            "TONY_CONF_PATH": os.path.join(self.app_dir, "config.json"),
+            **spec.env,
+        }
+        log_path = os.path.join(
+            self.app_dir, "logs", f"{spec.name}_{index}_attempt{attempt}.log"
+        )
+        return ContainerRequest(
+            task_type=spec.name,
+            task_index=index,
+            resource=Resource(spec.memory_mb, spec.cpus, spec.tpu_chips),
+            argv=[python, "-m", "tony_tpu.executor"],
+            env=env,
+            log_path=log_path,
+            node_label=spec.node_label,
+        )
+
+    def _on_allocated(self, job_name: str, index: int, cid: str, log_path: str) -> None:
+        t = self.session.task(job_name, index)
+        if t is not None:
+            t.log_path = log_path
+        self.events.emit(
+            EventType.TASK_STARTED,
+            task=f"{job_name}:{index}",
+            container=cid,
+            attempt=t.attempt if t else 0,
+        )
+
+    # --- RPC handlers (executor-facing) -------------------------------------
+
+    def RegisterWorkerSpec(self, request, context):  # noqa: N802
+        ok = self.session.register(
+            request.job_name, request.index, request.host, request.port, request.attempt
+        )
+        if ok:
+            self.events.emit(
+                EventType.TASK_REGISTERED,
+                task=f"{request.job_name}:{request.index}",
+                address=f"{request.host}:{request.port}",
+                attempt=request.attempt,
+            )
+            log.info(
+                "registered %s:%d at %s:%d (attempt %d)",
+                request.job_name, request.index, request.host, request.port, request.attempt,
+            )
+        return pb.RegisterWorkerSpecResponse(
+            accepted=ok, message="" if ok else "unknown task or stale attempt"
+        )
+
+    def GetClusterSpec(self, request, context):  # noqa: N802
+        task = self.session.task(request.job_name, request.index)
+        if task is None:
+            return pb.GetClusterSpecResponse(ready=False)
+        if self._scheduler_mode == "FCFS":
+            ready = self._fcfs_ready(request.job_name)
+        else:
+            ready = self.session.all_registered()
+        if not ready:
+            return pb.GetClusterSpecResponse(ready=False)
+        if task.state == TaskState.REGISTERED:
+            task.state = TaskState.RUNNING
+        table = self.session.rank_table()
+        coord = self.session.coordinator_task()
+        return pb.GetClusterSpecResponse(
+            ready=True,
+            spec_json=self.session.cluster_spec_json(),
+            coordinator_address=coord.address if coord else "",
+            process_id=table.get(task.task_id, -1),
+            num_processes=len(table),
+            generation=self.session.generation,
+        )
+
+    def _fcfs_ready(self, job_name: str) -> bool:
+        """FCFS: a task may proceed once its own type + dependency chain are up."""
+        spec = self.specs[job_name]
+        names = {job_name}
+        dep = spec.depends_on
+        while dep:
+            names.add(dep)
+            dep = self.specs[dep].depends_on if dep in self.specs else ""
+        return all(
+            t.state not in (TaskState.PENDING, TaskState.ALLOCATED)
+            for n in names
+            for t in self.session.tasks_of_type(n)
+        )
+
+    def Heartbeat(self, request, context):  # noqa: N802
+        task = self.session.task(request.job_name, request.index)
+        if task is None or request.attempt != task.attempt or self._killed.is_set():
+            return pb.HeartbeatResponse(action=pb.HeartbeatResponse.ABORT)
+        task.last_heartbeat = time.monotonic()
+        return pb.HeartbeatResponse(action=pb.HeartbeatResponse.NONE)
+
+    def RegisterExecutionResult(self, request, context):  # noqa: N802
+        self._notifications.put(
+            ("result", (request.job_name, request.index, request.exit_code, request.attempt))
+        )
+        return pb.RegisterExecutionResultResponse(acknowledged=True)
+
+    def RegisterTensorBoardUrl(self, request, context):  # noqa: N802
+        self.session.tensorboard_url = request.url
+        self.events.emit(EventType.METADATA, tensorboard_url=request.url)
+        return pb.Empty()
+
+    def PushMetrics(self, request, context):  # noqa: N802
+        tid = f"{request.job_name}:{request.index}"
+        self._latest_metrics[tid] = {s.name: s.value for s in request.samples}
+        return pb.Empty()
+
+    # --- RPC handlers (client-facing) ----------------------------------------
+
+    def GetTaskInfos(self, request, context):  # noqa: N802
+        return pb.GetTaskInfosResponse(tasks=self._task_infos())
+
+    def GetApplicationStatus(self, request, context):  # noqa: N802
+        state = self.session.state
+        code = 0
+        if state in (JobState.SUCCEEDED, JobState.FAILED, JobState.KILLED):
+            _, code = self.session.final_status()
+            if state == JobState.KILLED:
+                code = 143
+        return pb.GetApplicationStatusResponse(
+            state=state.value,
+            exit_code=code,
+            diagnostics=self.session.diagnostics,
+            tensorboard_url=self.session.tensorboard_url,
+            tasks=self._task_infos(),
+        )
+
+    def StopApplication(self, request, context):  # noqa: N802
+        log.info("stop requested: %s", request.reason)
+        self.session.diagnostics = request.reason or "stopped by client"
+        self._killed.set()
+        self._notifications.put(("stop", None))
+        return pb.Empty()
+
+    def _task_infos(self) -> list[pb.TaskInfo]:
+        with self.session.lock:
+            return [
+                pb.TaskInfo(
+                    job_name=t.job_name,
+                    index=t.index,
+                    host=t.host,
+                    port=t.port,
+                    state=t.state.value,
+                    exit_code=t.exit_code or 0,
+                    attempt=t.attempt,
+                    log_path=t.log_path,
+                )
+                for t in self.session.tasks.values()
+            ]
+
+    # --- backend callback ----------------------------------------------------
+
+    def _on_container_completed(self, container: Container, code: int) -> None:
+        self._notifications.put(
+            ("container", (container.request.task_type, container.request.task_index,
+                           container.container_id, code))
+        )
+
+    # --- supervision loop -----------------------------------------------------
+
+    def run(self) -> int:
+        """Run the job to completion; returns the client exit code."""
+        os.makedirs(os.path.join(self.app_dir, "logs"), exist_ok=True)
+        self._server, self.port = serve(
+            self, port=self.config.get_int(Keys.AM_RPC_PORT, 0)
+        )
+        # The client discovers the AM address from this file (the YARN
+        # application-report analogue).
+        addr_path = os.path.join(self.app_dir, "am.addr")
+        with open(addr_path + ".tmp", "w") as f:
+            f.write(f"127.0.0.1:{self.port}")
+        os.replace(addr_path + ".tmp", addr_path)
+        self.events.emit(
+            EventType.APPLICATION_INITED,
+            specs={n: s.to_dict() for n, s in self.specs.items()},
+            framework=self.config.get_str(Keys.APPLICATION_FRAMEWORK),
+        )
+        self.backend.set_completion_callback(self._on_container_completed)
+        self.backend.start()
+        self.session.state = JobState.RUNNING
+        deadline = None
+        timeout_s = self.config.get_int(Keys.APPLICATION_TIMEOUT_S, 0)
+        if timeout_s > 0:
+            deadline = time.monotonic() + timeout_s
+        try:
+            self.scheduler.schedule_all(self.specs)
+            self._supervise(deadline)
+        except Exception as e:
+            log.exception("AM failed")
+            self.session.state = JobState.FAILED
+            self.session.diagnostics = f"{type(e).__name__}: {e}"
+        finally:
+            self._teardown()
+        _, code = self.session.final_status()
+        if self.session.state == JobState.KILLED:
+            code = 143
+        elif self.session.state == JobState.FAILED and code == 0:
+            code = 1
+        self._write_status(code)
+        return code
+
+    def _supervise(self, deadline: float | None) -> None:
+        while True:
+            if self._killed.is_set():
+                self.session.state = JobState.KILLED
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                self.session.diagnostics = "application timeout"
+                self.session.state = JobState.FAILED
+                return
+            try:
+                kind, payload = self._notifications.get(timeout=self._heartbeat_interval_s)
+            except queue.Empty:
+                kind, payload = "", None
+            if kind == "stop":
+                self.session.state = JobState.KILLED
+                return
+            if kind == "result":
+                job_name, index, exit_code, attempt = payload
+                task = self.session.task(job_name, index)
+                if task is not None and attempt == task.attempt:
+                    self._finish_task(job_name, index, exit_code)
+            elif kind == "container":
+                job_name, index, cid, code = payload
+                task = self.session.task(job_name, index)
+                # Only meaningful if this is still the task's current
+                # container and no result was reported (executor crash).
+                if task is not None and task.container_id == cid and task.state not in TERMINAL:
+                    self._finish_task(job_name, index, code if code != 0 else 0)
+            self._check_heartbeats()
+            if self._apply_failure_policy():
+                return
+            if self.session.job_done():
+                state, _ = self.session.final_status()
+                self.session.state = state
+                return
+
+    def _finish_task(self, job_name: str, index: int, exit_code: int) -> None:
+        self.session.on_task_completed(job_name, index, exit_code)
+        t = self.session.task(job_name, index)
+        self.events.emit(
+            EventType.TASK_FINISHED,
+            task=f"{job_name}:{index}",
+            exit_code=exit_code,
+            state=t.state.value if t else "",
+        )
+        log.info("task %s:%d finished code=%d", job_name, index, exit_code)
+
+    def _check_heartbeats(self) -> None:
+        if self._max_missed <= 0:
+            return
+        cutoff = time.monotonic() - self._heartbeat_interval_s * self._max_missed
+        with self.session.lock:
+            stale = [
+                t
+                for t in self.session.tasks.values()
+                if t.state in (TaskState.REGISTERED, TaskState.RUNNING)
+                and t.last_heartbeat > 0
+                and t.last_heartbeat < cutoff
+            ]
+        for t in stale:
+            log.warning("task %s lost (missed heartbeats)", t.task_id)
+            self.session.on_task_lost(t.job_name, t.index)
+            self.events.emit(EventType.TASK_FINISHED, task=t.task_id, state="LOST")
+            if t.container_id:
+                self.backend.release(t.container_id)
+
+    def _apply_failure_policy(self) -> bool:
+        """Handle failed/lost tracked tasks. Returns True if the job is over."""
+        failed = self.session.failed_tasks()
+        if not failed:
+            return False
+        # chief semantics: a finished chief ends the job regardless of policy
+        if self.session.chief_type and any(
+            t.job_name == self.session.chief_type for t in failed
+        ):
+            self.session.state = JobState.FAILED
+            self.session.diagnostics = "chief failed"
+            return True
+        if self._restart_policy == "never":
+            self.session.state = JobState.FAILED
+            self.session.diagnostics = (
+                f"task(s) failed: {', '.join(t.task_id for t in failed)}"
+            )
+            return True
+        over_budget = [t for t in failed if t.restarts >= self._max_restarts]
+        if over_budget:
+            self.session.state = JobState.FAILED
+            self.session.diagnostics = (
+                "restart budget exhausted for "
+                + ", ".join(t.task_id for t in over_budget)
+            )
+            return True
+        if self._restart_policy == "gang":
+            self._gang_restart()
+        else:  # failed_only
+            self._restart_tasks({t.job_name for t in failed}, only_failed=True)
+        return False
+
+    def _gang_restart(self) -> None:
+        """Barrier-restart the whole gang (fixed-topology TPU slice semantics).
+
+        Every container is released, every task reset to PENDING with a bumped
+        attempt (stale executors get ABORT on their next heartbeat), and the
+        scheduler re-launches the full job. User scripts resume from the last
+        checkpoint (restart.resume_from_checkpoint glue in the trainer).
+        """
+        log.warning("gang restart (generation %d)", self.session.generation + 1)
+        self.events.emit(EventType.GANG_RESTART, generation=self.session.generation + 1)
+        with self.session.lock:
+            cids = [t.container_id for t in self.session.tasks.values() if t.container_id]
+        for cid in cids:
+            self.backend.release(cid)
+        self.session.reset_for_restart(None)
+        self._drain_notifications()
+        self.scheduler.schedule_all(self.specs)
+
+    def _restart_tasks(self, job_names: set[str], only_failed: bool) -> None:
+        with self.session.lock:
+            victims = [
+                t
+                for t in self.session.tasks.values()
+                if t.job_name in job_names
+                and (not only_failed or t.state in (TaskState.FAILED, TaskState.LOST))
+            ]
+            for t in victims:
+                if t.container_id:
+                    self.backend.release(t.container_id)
+                t.state = TaskState.PENDING
+                t.host, t.port = "", 0
+                t.container_id = ""
+                t.exit_code = None
+                t.attempt += 1
+                t.restarts += 1
+                t.last_heartbeat = 0.0
+        log.warning("restarting %s", ", ".join(t.task_id for t in victims))
+        self.scheduler.schedule_all(self.specs)
+
+    def _drain_notifications(self) -> None:
+        """Drop queued notifications from superseded attempts after a restart."""
+        try:
+            while True:
+                self._notifications.get_nowait()
+        except queue.Empty:
+            pass
+
+    def _teardown(self) -> None:
+        self.scheduler.stop()
+        self.backend.stop()
+        self.events.emit(
+            EventType.APPLICATION_FINISHED,
+            state=self.session.state.value,
+            diagnostics=self.session.diagnostics,
+        )
+        self.events.close()
+        # Leave the RPC server up briefly so the client's final status poll
+        # lands; the process exits right after run() returns anyway.
+
+    def _write_status(self, code: int) -> None:
+        status = {
+            "app_id": self.app_id,
+            "state": self.session.state.value,
+            "exit_code": code,
+            "diagnostics": self.session.diagnostics,
+            "tensorboard_url": self.session.tensorboard_url,
+            "tasks": [
+                {
+                    "task": t.task_id,
+                    "state": t.state.value,
+                    "exit_code": t.exit_code,
+                    "attempts": t.attempt + 1,
+                    "log": t.log_path,
+                }
+                for t in self.session.tasks.values()
+            ],
+        }
+        path = os.path.join(self.app_dir, "status.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(status, f, indent=2, sort_keys=True)
+        os.replace(path + ".tmp", path)
+
+
+def main() -> None:
+    """AM process entry: ``python -m tony_tpu.am.app_master <app_dir>``."""
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s AM %(levelname)s %(name)s: %(message)s",
+    )
+    app_dir = sys.argv[1]
+    app_id = os.path.basename(app_dir.rstrip("/"))
+    config = TonyConfig.from_json(
+        open(os.path.join(app_dir, "config.json")).read()
+    )
+    am = ApplicationMaster(config, app_id, app_dir)
+    code = am.run()
+    # Give the client one status-poll interval to observe the final state.
+    time.sleep(1.0)
+    if am._server is not None:
+        am._server.stop(0.5)
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

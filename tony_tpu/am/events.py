@@ -1,0 +1,103 @@
+"""Event/history pipeline: the .jhist analogue.
+
+The reference defines avro events (ApplicationInited / TaskStarted /
+TaskFinished / ApplicationFinished / Metadata), written by an AM EventHandler
+thread to an HDFS intermediate dir and moved to a finished dir on exit, where
+the portal reads them (SURVEY.md sections 2, 3.5). Here events are JSONL (one
+object per line, ``{"type": ..., "ts": ..., ...fields}``) in
+``<history.intermediate_dir>/<app_id>.jhist.jsonl``, atomically moved to
+``<history.finished_dir>`` at teardown; the bundled portal (obs/portal.py)
+reads the finished dir.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any
+
+
+class EventType:
+    APPLICATION_INITED = "APPLICATION_INITED"
+    TASK_STARTED = "TASK_STARTED"
+    TASK_REGISTERED = "TASK_REGISTERED"
+    TASK_FINISHED = "TASK_FINISHED"
+    GANG_RESTART = "GANG_RESTART"
+    APPLICATION_FINISHED = "APPLICATION_FINISHED"
+    METADATA = "METADATA"
+    METRICS = "METRICS"
+
+
+class EventWriter:
+    """Async JSONL event writer (the EventHandler-thread analogue).
+
+    Events are enqueued from RPC/monitor threads and drained by one writer
+    thread, so event IO never blocks the control plane.
+    """
+
+    def __init__(self, app_id: str, intermediate_dir: str, finished_dir: str = ""):
+        self.app_id = app_id
+        self.intermediate_dir = intermediate_dir
+        self.finished_dir = finished_dir or intermediate_dir
+        self._q: queue.Queue[dict[str, Any] | None] = queue.Queue()
+        self._path = ""
+        self._thread: threading.Thread | None = None
+        if intermediate_dir:
+            os.makedirs(intermediate_dir, exist_ok=True)
+            self._path = os.path.join(intermediate_dir, f"{app_id}.jhist.jsonl")
+            self._thread = threading.Thread(
+                target=self._drain, daemon=True, name="event-writer"
+            )
+            self._thread.start()
+
+    def emit(self, event_type: str, **fields: Any) -> None:
+        if not self._path:
+            return
+        self._q.put({"type": event_type, "ts": time.time(), "app_id": self.app_id, **fields})
+
+    def _drain(self) -> None:
+        with open(self._path, "a", encoding="utf-8") as f:
+            while True:
+                item = self._q.get()
+                if item is None:
+                    f.flush()
+                    return
+                f.write(json.dumps(item, sort_keys=True) + "\n")
+                f.flush()
+
+    def close(self) -> None:
+        """Flush, stop the writer, and move the file to the finished dir."""
+        if not self._path:
+            return
+        self._q.put(None)
+        if self._thread:
+            self._thread.join(timeout=10)
+        if self.finished_dir != self.intermediate_dir:
+            os.makedirs(self.finished_dir, exist_ok=True)
+            dst = os.path.join(self.finished_dir, os.path.basename(self._path))
+            try:
+                os.replace(self._path, dst)
+                self._path = dst
+            except OSError:
+                pass
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+
+def read_history(path: str) -> list[dict[str, Any]]:
+    """Parse a .jhist.jsonl file (portal read path)."""
+    events = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+__all__ = ["EventType", "EventWriter", "read_history"]

@@ -1,0 +1,169 @@
+"""TaskScheduler: orders container requests, honours dependencies.
+
+Rebuild of the reference's ``TaskScheduler.scheduleTasks`` (SURVEY.md
+section 2): inter-task-type dependencies with timeouts (e.g. workers wait on
+ps), GANG vs FCFS distributed modes, plus the partial-allocation guard the
+survey ranks as hard part #3 (AM holds some containers while waiting for the
+rest -> allocation timeout + release).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Mapping
+
+from tony_tpu.am.session import Session, TaskState
+from tony_tpu.cluster.backend import (
+    ClusterBackend,
+    ContainerRequest,
+    InsufficientResources,
+    Resource,
+)
+from tony_tpu.config.config import TaskTypeSpec
+
+log = logging.getLogger(__name__)
+
+
+class DependencyTimeout(RuntimeError):
+    """A task type's depends_on did not reach readiness within its timeout."""
+
+
+class AllocationTimeout(RuntimeError):
+    """Gang allocation did not complete within am.allocation_timeout_s."""
+
+
+@dataclass
+class SchedulerHooks:
+    """How the scheduler launches things (wired by the AM)."""
+
+    # builds the executor ContainerRequest for a task instance
+    make_request: Callable[[TaskTypeSpec, int], ContainerRequest]
+    # called after a container is granted (records container_id on the task)
+    on_allocated: Callable[[str, int, str, str], None]  # job_name, idx, cid, log_path
+
+
+class TaskScheduler:
+    """Dependency-ordered, mode-aware container scheduling.
+
+    GANG (default): all types are launched as resources permit, but the
+    *cluster spec* is withheld until everyone registers (the barrier lives in
+    Session.all_registered). FCFS: same launch order, but GetClusterSpec
+    answers as soon as the asking task's own dependencies are satisfied —
+    used for PS-style jobs where workers may start before all workers exist.
+
+    depends_on gates *launch*: a type with ``depends_on = "ps"`` is not even
+    allocated until every ps instance has REGISTERED (matches the reference's
+    dependency-with-timeout semantics).
+    """
+
+    def __init__(
+        self,
+        session: Session,
+        backend: ClusterBackend,
+        hooks: SchedulerHooks,
+        *,
+        allocation_timeout_s: float = 300.0,
+        poll_interval_s: float = 0.2,
+    ):
+        self.session = session
+        self.backend = backend
+        self.hooks = hooks
+        self.allocation_timeout_s = allocation_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self._stop = False
+
+    def stop(self) -> None:
+        self._stop = True
+
+    # --- dependency evaluation ----------------------------------------------
+
+    def _dependency_ready(self, spec: TaskTypeSpec) -> bool:
+        if not spec.depends_on:
+            return True
+        dep_tasks = self.session.tasks_of_type(spec.depends_on)
+        if not dep_tasks:
+            raise ValueError(
+                f"job type {spec.name!r} depends on unknown type {spec.depends_on!r}"
+            )
+        return all(
+            t.state in (TaskState.REGISTERED, TaskState.RUNNING, TaskState.SUCCEEDED)
+            for t in dep_tasks
+        )
+
+    # --- main entry ---------------------------------------------------------
+
+    def schedule_all(self, specs: Mapping[str, TaskTypeSpec]) -> None:
+        """Launch every PENDING task, honouring dependencies and inventory.
+
+        Blocks until all tasks are allocated or a timeout fires. Safe to call
+        again after a gang restart (only PENDING tasks are touched).
+        """
+        deadline = time.monotonic() + self.allocation_timeout_s
+        dep_deadlines: dict[str, float] = {}
+        total_ask = self._total_ask(specs)
+        cap = self.backend.total_capacity()
+        if not total_ask.fits_in(cap):
+            raise InsufficientResources(
+                f"job needs {total_ask} but cluster capacity is {cap}"
+            )
+        while not self._stop:
+            progress = False
+            pending_left = False
+            for name in sorted(specs):
+                spec = specs[name]
+                pending = [
+                    t
+                    for t in self.session.tasks_of_type(name)
+                    if t.state == TaskState.PENDING
+                ]
+                if not pending:
+                    continue
+                if not self._dependency_ready(spec):
+                    pending_left = True
+                    dl = dep_deadlines.setdefault(
+                        name,
+                        time.monotonic() + (spec.depends_timeout_s or self.allocation_timeout_s),
+                    )
+                    if time.monotonic() > dl:
+                        raise DependencyTimeout(
+                            f"type {name!r} waited too long on {spec.depends_on!r}"
+                        )
+                    continue
+                for t in pending:
+                    req = self.hooks.make_request(spec, t.index)
+                    try:
+                        container = self.backend.allocate(req)
+                    except InsufficientResources:
+                        pending_left = True
+                        break  # inventory full now; retry next sweep
+                    t.state = TaskState.ALLOCATED
+                    t.container_id = container.container_id
+                    t.host = container.host
+                    t.started_at = time.time()
+                    self.hooks.on_allocated(
+                        name, t.index, container.container_id, req.log_path
+                    )
+                    progress = True
+            if not pending_left and all(
+                t.state != TaskState.PENDING for t in self.session.tasks.values()
+            ):
+                return
+            if time.monotonic() > deadline:
+                raise AllocationTimeout(
+                    f"gang allocation incomplete after {self.allocation_timeout_s}s"
+                )
+            if not progress:
+                time.sleep(self.poll_interval_s)
+
+    @staticmethod
+    def _total_ask(specs: Mapping[str, TaskTypeSpec]) -> Resource:
+        total = Resource(0, 0, 0)
+        for spec in specs.values():
+            for _ in range(spec.instances):
+                total = total + Resource(spec.memory_mb, spec.cpus, spec.tpu_chips)
+        return total
+
+
+__all__ = ["AllocationTimeout", "DependencyTimeout", "SchedulerHooks", "TaskScheduler"]

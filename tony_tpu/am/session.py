@@ -1,0 +1,243 @@
+"""Session: AM-side job state machine.
+
+Rebuild of the reference's ``TonySession`` / ``TonySession.TonyTask``
+(SURVEY.md section 2): the task table, per-type counts, cluster-spec JSON
+builder, completion/failure accounting, and the final-status decision
+(untracked types excluded; chief semantics optional). All mutation goes
+through one lock — the reference leans on concurrent collections inside a
+multi-threaded AM; here threads are the RPC pool + monitor loop.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tony_tpu.config.config import TaskTypeSpec
+
+
+class TaskState(enum.Enum):
+    PENDING = "PENDING"          # not yet allocated
+    ALLOCATED = "ALLOCATED"      # container granted, executor starting
+    REGISTERED = "REGISTERED"    # executor registered (host:port known)
+    RUNNING = "RUNNING"          # cluster spec delivered, user proc running
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    LOST = "LOST"                # heartbeat loss / container vanished
+
+
+TERMINAL = frozenset({TaskState.SUCCEEDED, TaskState.FAILED, TaskState.LOST})
+
+
+class JobState(enum.Enum):
+    NEW = "NEW"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+
+@dataclass
+class Task:
+    """One task instance (the TonyTask analogue)."""
+
+    job_name: str
+    index: int
+    state: TaskState = TaskState.PENDING
+    host: str = ""
+    port: int = 0
+    container_id: str = ""
+    exit_code: int | None = None
+    attempt: int = 0             # bumped on every restart
+    restarts: int = 0
+    last_heartbeat: float = 0.0
+    log_path: str = ""
+    started_at: float = 0.0
+    finished_at: float = 0.0
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.job_name}:{self.index}"
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class Session:
+    """Job state: task table + gang barrier + final-status accounting."""
+
+    def __init__(self, specs: dict[str, TaskTypeSpec], *, chief_type: str = ""):
+        self.specs = specs
+        self.chief_type = chief_type  # if set, job finishes when chief does
+        self.lock = threading.RLock()
+        self.tasks: dict[str, Task] = {}
+        self.state = JobState.NEW
+        self.diagnostics = ""
+        self.tensorboard_url = ""
+        # generation bumps on every gang restart; executors of an older
+        # generation are told to ABORT on heartbeat.
+        self.generation = 0
+        for spec in specs.values():
+            for i in range(spec.instances):
+                t = Task(job_name=spec.name, index=i)
+                self.tasks[t.task_id] = t
+
+    # --- lookups -----------------------------------------------------------
+
+    def task(self, job_name: str, index: int) -> Task | None:
+        return self.tasks.get(f"{job_name}:{index}")
+
+    def tasks_of_type(self, job_name: str) -> list[Task]:
+        return sorted(
+            (t for t in self.tasks.values() if t.job_name == job_name),
+            key=lambda t: t.index,
+        )
+
+    def tracked_tasks(self) -> list[Task]:
+        return [t for t in self.tasks.values() if not self.specs[t.job_name].untracked]
+
+    # --- registration / gang barrier ---------------------------------------
+
+    def register(self, job_name: str, index: int, host: str, port: int, attempt: int) -> bool:
+        """Record an executor registration. Returns False for unknown/stale."""
+        with self.lock:
+            t = self.task(job_name, index)
+            if t is None or attempt != t.attempt:
+                return False
+            t.host, t.port = host, port
+            t.state = TaskState.REGISTERED
+            t.last_heartbeat = time.monotonic()
+            return True
+
+    def all_registered(self) -> bool:
+        """The gang barrier: every instance of every type has registered.
+
+        The reference assembles the cluster spec only after *all* task types
+        register (SURVEY.md section 3.1 "gang barrier"); untracked types (e.g.
+        tensorboard) are included in the spec but a job that defines them
+        cannot hang on them — they still must register since they occupy
+        containers. FCFS mode relaxes this per-type (see TaskScheduler).
+        """
+        with self.lock:
+            return all(
+                t.state not in (TaskState.PENDING, TaskState.ALLOCATED)
+                for t in self.tasks.values()
+            )
+
+    def cluster_spec_json(self) -> str:
+        """``{"worker": ["host:port", ...], "ps": [...]}`` — the TF_CONFIG shape."""
+        with self.lock:
+            spec = {
+                name: [t.address for t in self.tasks_of_type(name)]
+                for name in self.specs
+            }
+        return json.dumps(spec, sort_keys=True)
+
+    # --- global rank assignment (jax.distributed contract) ------------------
+
+    def rank_table(self) -> dict[str, int]:
+        """task_id -> global rank, deterministic across processes.
+
+        Ranks are assigned over *tracked* types in sorted-type order then
+        index order, so the coordinator (rank 0) is the first instance of the
+        first tracked type. Matches the JaxTpuRuntime contract: process_id is
+        stable under gang restart (same table, new attempt numbers).
+        """
+        with self.lock:
+            ranked = [
+                t
+                for name in sorted(self.specs)
+                if not self.specs[name].untracked
+                for t in self.tasks_of_type(name)
+            ]
+            return {t.task_id: i for i, t in enumerate(ranked)}
+
+    def coordinator_task(self) -> Task | None:
+        table = self.rank_table()
+        for tid, rank in table.items():
+            if rank == 0:
+                return self.tasks[tid]
+        return None
+
+    # --- completion accounting ----------------------------------------------
+
+    def on_task_completed(self, job_name: str, index: int, exit_code: int) -> None:
+        with self.lock:
+            t = self.task(job_name, index)
+            if t is None or t.state in TERMINAL:
+                return
+            t.exit_code = exit_code
+            t.finished_at = time.time()
+            t.state = TaskState.SUCCEEDED if exit_code == 0 else TaskState.FAILED
+
+    def on_task_lost(self, job_name: str, index: int) -> None:
+        with self.lock:
+            t = self.task(job_name, index)
+            if t is None or t.state in TERMINAL:
+                return
+            t.finished_at = time.time()
+            t.state = TaskState.LOST
+
+    def failed_tasks(self) -> list[Task]:
+        with self.lock:
+            return [
+                t
+                for t in self.tracked_tasks()
+                if t.state in (TaskState.FAILED, TaskState.LOST)
+            ]
+
+    def job_done(self) -> bool:
+        """Done when all tracked tasks are terminal, or the chief is."""
+        with self.lock:
+            tracked = self.tracked_tasks()
+            if not tracked:
+                return True
+            if self.chief_type:
+                chief = [t for t in tracked if t.job_name == self.chief_type]
+                if chief and all(t.state in TERMINAL for t in chief):
+                    return True
+            return all(t.state in TERMINAL for t in tracked)
+
+    def final_status(self) -> tuple[JobState, int]:
+        """(job state, client exit code) — untracked types never fail a job."""
+        with self.lock:
+            tracked = self.tracked_tasks()
+            if self.chief_type:
+                tracked = [t for t in tracked if t.job_name == self.chief_type] or tracked
+            bad = [t for t in tracked if t.state in (TaskState.FAILED, TaskState.LOST)]
+            if bad:
+                code = next((t.exit_code for t in bad if t.exit_code), 1) or 1
+                return JobState.FAILED, code
+            return JobState.SUCCEEDED, 0
+
+    # --- gang restart (elastic path) ----------------------------------------
+
+    def reset_for_restart(self, job_names: set[str] | None = None) -> list[Task]:
+        """Reset tasks to PENDING for re-launch; bump attempt + generation.
+
+        ``job_names=None`` resets every task — the TPU barrier-restart
+        (fixed-topology slice: one lost host restarts the whole gang,
+        SURVEY.md section 5). Returns the reset tasks.
+        """
+        with self.lock:
+            self.generation += 1
+            reset: list[Task] = []
+            for t in self.tasks.values():
+                if job_names is not None and t.job_name not in job_names:
+                    continue
+                t.state = TaskState.PENDING
+                t.host, t.port = "", 0
+                t.container_id = ""
+                t.exit_code = None
+                t.attempt += 1
+                t.restarts += 1
+                t.last_heartbeat = 0.0
+                reset.append(t)
+            return reset
+
+
+__all__ = ["JobState", "Session", "Task", "TaskState", "TERMINAL"]

@@ -1,0 +1,1 @@
+"""Submission surface: TonyClient + the `tony` CLI (run as python -m tony_tpu.cli)."""

@@ -1,0 +1,173 @@
+"""TonyClient: submit and track one application.
+
+Rebuild of the reference's ``TonyClient`` (SURVEY.md sections 2, 3.1): parse
+config, stage the user's src dir + config into the application dir (the HDFS
+staging analogue), launch the ApplicationMaster, then poll status until the
+job is terminal and propagate its exit code. Where the reference submits an
+AM container to the YARN RM and polls application reports, this client spawns
+the AM process directly (the local substrate's RM role) and polls the AM's
+own status RPC.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import time
+import uuid
+
+import grpc
+
+from tony_tpu.config.config import TonyConfig
+from tony_tpu.config.keys import Keys
+from tony_tpu.rpc import ApplicationRpcClient
+
+log = logging.getLogger(__name__)
+
+TERMINAL_STATES = {"SUCCEEDED", "FAILED", "KILLED"}
+
+
+def default_apps_root() -> str:
+    return os.environ.get(
+        "TONY_APPS_ROOT", os.path.join(os.path.expanduser("~"), ".tony-tpu", "apps")
+    )
+
+
+def resolve_app_dir(app: str) -> str:
+    """Accept an app id (under the apps root) or a path to an app dir."""
+    if os.path.isdir(app):
+        return os.path.abspath(app)
+    candidate = os.path.join(default_apps_root(), app)
+    if os.path.isdir(candidate):
+        return candidate
+    raise FileNotFoundError(f"unknown application {app!r}")
+
+
+class TonyClient:
+    def __init__(self, config: TonyConfig, src_dir: str = ""):
+        self.config = config
+        self.src_dir = src_dir
+        self.app_id = self._make_app_id()
+        stage_root = config.get_str(Keys.APPLICATION_PREPARE_STAGE_DIR) or default_apps_root()
+        self.app_dir = os.path.join(stage_root, self.app_id)
+        self._am_proc: subprocess.Popen | None = None
+
+    def _make_app_id(self) -> str:
+        name = self.config.get_str(Keys.APPLICATION_NAME, "tony-tpu-job")
+        safe = "".join(c if c.isalnum() or c in "-_" else "-" for c in name)
+        return f"{safe}-{time.strftime('%Y%m%d-%H%M%S')}-{uuid.uuid4().hex[:6]}"
+
+    # --- submission ----------------------------------------------------------
+
+    def stage(self) -> None:
+        """Materialise the application dir: config.json + src/ copy."""
+        os.makedirs(self.app_dir, exist_ok=True)
+        with open(os.path.join(self.app_dir, "config.json"), "w") as f:
+            f.write(self.config.to_json())
+        if self.src_dir:
+            dst = os.path.join(self.app_dir, "src")
+            shutil.copytree(self.src_dir, dst, dirs_exist_ok=True)
+
+    def launch_am(self) -> None:
+        am_log = open(os.path.join(self.app_dir, "am.log"), "ab")
+        env = dict(os.environ)
+        # Make the tony_tpu package importable in the AM (and, transitively,
+        # in executors) even when it is run from a source checkout.
+        import tony_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(tony_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        self._am_proc = subprocess.Popen(
+            [sys.executable, "-m", "tony_tpu.am.app_master", self.app_dir],
+            stdout=am_log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env=env,
+        )
+        log.info("launched AM pid=%d app_dir=%s", self._am_proc.pid, self.app_dir)
+
+    def am_address(self, timeout_s: float = 30.0) -> str:
+        path = os.path.join(self.app_dir, "am.addr")
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                with open(path) as f:
+                    return f.read().strip()
+            if self._am_proc is not None and self._am_proc.poll() is not None:
+                raise RuntimeError(
+                    f"AM exited early (code {self._am_proc.returncode}); "
+                    f"see {os.path.join(self.app_dir, 'am.log')}"
+                )
+            time.sleep(0.2)
+        raise TimeoutError("AM did not publish its address in time")
+
+    # --- tracking -------------------------------------------------------------
+
+    def monitor(self, poll_interval_s: float = 1.0, quiet: bool = False) -> int:
+        """Poll status until terminal; mirrors the reference client's report loop."""
+        addr = self.am_address()
+        client = ApplicationRpcClient(addr)
+        last_states: dict[str, str] = {}
+        printed_tb = False
+        try:
+            while True:
+                try:
+                    status = client.get_application_status()
+                except grpc.RpcError:
+                    # AM gone: fall back to the status file it wrote on exit.
+                    return self._final_from_status_file()
+                if not quiet:
+                    for t in status.tasks:
+                        tid = f"{t.job_name}:{t.index}"
+                        if last_states.get(tid) != t.state:
+                            last_states[tid] = t.state
+                            print(f"[{self.app_id}] {tid} -> {t.state}")
+                    if status.tensorboard_url and not printed_tb:
+                        printed_tb = True
+                        print(f"[{self.app_id}] tensorboard: {status.tensorboard_url}")
+                if status.state in TERMINAL_STATES:
+                    if not quiet:
+                        print(
+                            f"[{self.app_id}] {status.state}"
+                            + (f": {status.diagnostics}" if status.diagnostics else "")
+                        )
+                    self._await_am_exit()
+                    return status.exit_code
+                time.sleep(poll_interval_s)
+        finally:
+            client.close()
+
+    def _await_am_exit(self, timeout_s: float = 15.0) -> None:
+        if self._am_proc is None:
+            return
+        try:
+            self._am_proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self._am_proc.terminate()
+
+    def _final_from_status_file(self) -> int:
+        path = os.path.join(self.app_dir, "status.json")
+        for _ in range(50):
+            if os.path.exists(path):
+                with open(path) as f:
+                    status = json.load(f)
+                print(f"[{self.app_id}] {status['state']} (from status.json)")
+                return int(status["exit_code"])
+            time.sleep(0.2)
+        log.error("AM vanished without status.json")
+        return 1
+
+    # --- one-shot -------------------------------------------------------------
+
+    def run(self, quiet: bool = False) -> int:
+        """stage -> launch AM -> monitor -> exit code (TonyClient.run analogue)."""
+        self.stage()
+        self.launch_am()
+        return self.monitor(quiet=quiet)
+
+
+__all__ = ["TonyClient", "TERMINAL_STATES", "default_apps_root", "resolve_app_dir"]

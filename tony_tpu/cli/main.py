@@ -1,0 +1,190 @@
+"""tony: the command-line submission surface.
+
+Rebuild of tony-cli's ClusterSubmitter/status surface (SURVEY.md section 2
+"tony-cli"): ``tony submit`` stages and runs a job to completion;
+``status`` / ``logs`` / ``stop`` / ``history`` operate on existing apps.
+
+    tony submit --conf job.toml --src-dir ./my_model -D job.worker.instances=4
+    tony status <app-id>
+    tony logs <app-id> [--task worker:0]
+    tony stop <app-id>
+    tony history [--dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+import grpc
+
+from tony_tpu.cli.client import TonyClient, default_apps_root, resolve_app_dir
+from tony_tpu.config.config import TonyConfig
+from tony_tpu.rpc import ApplicationRpcClient
+
+
+def _read_am_addr(app_dir: str) -> str | None:
+    path = os.path.join(app_dir, "am.addr")
+    if os.path.exists(path):
+        with open(path) as f:
+            return f.read().strip()
+    return None
+
+
+def cmd_submit(args: argparse.Namespace) -> int:
+    config = TonyConfig.load(args.conf, overrides=args.define, read_env=True)
+    client = TonyClient(config, src_dir=args.src_dir or "")
+    if args.detach:
+        client.stage()
+        client.launch_am()
+        client.am_address()
+        print(client.app_id)
+        return 0
+    return client.run(quiet=args.quiet)
+
+
+def _status_dict(app_dir: str) -> dict:
+    addr = _read_am_addr(app_dir)
+    if addr:
+        try:
+            with ApplicationRpcClient(addr, timeout_s=3.0) as c:
+                s = c.get_application_status()
+                return {
+                    "state": s.state,
+                    "exit_code": s.exit_code,
+                    "diagnostics": s.diagnostics,
+                    "tensorboard_url": s.tensorboard_url,
+                    "tasks": [
+                        {
+                            "task": f"{t.job_name}:{t.index}",
+                            "state": t.state,
+                            "exit_code": t.exit_code,
+                            "attempt": t.attempt,
+                            "log": t.log_path,
+                        }
+                        for t in s.tasks
+                    ],
+                }
+        except grpc.RpcError:
+            pass
+    path = os.path.join(app_dir, "status.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {"state": "UNKNOWN", "exit_code": -1, "tasks": []}
+
+
+def cmd_status(args: argparse.Namespace) -> int:
+    app_dir = resolve_app_dir(args.app)
+    print(json.dumps(_status_dict(app_dir), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_logs(args: argparse.Namespace) -> int:
+    app_dir = resolve_app_dir(args.app)
+    logs_dir = os.path.join(app_dir, "logs")
+    names = sorted(os.listdir(logs_dir)) if os.path.isdir(logs_dir) else []
+    if args.task:
+        prefix = args.task.replace(":", "_") + "_"
+        names = [n for n in names if n.startswith(prefix)]
+    if args.am:
+        names = ["../am.log"]
+    if not names:
+        print("no logs found", file=sys.stderr)
+        return 1
+    for name in names:
+        path = os.path.join(logs_dir, name)
+        print(f"===== {name} =====")
+        try:
+            with open(path, errors="replace") as f:
+                sys.stdout.write(f.read())
+        except OSError as e:
+            print(f"<unreadable: {e}>")
+    return 0
+
+
+def cmd_stop(args: argparse.Namespace) -> int:
+    app_dir = resolve_app_dir(args.app)
+    addr = _read_am_addr(app_dir)
+    if not addr:
+        print("AM address unknown; application may not be running", file=sys.stderr)
+        return 1
+    try:
+        with ApplicationRpcClient(addr, timeout_s=5.0) as c:
+            c.stop_application(args.reason)
+        print("stop requested")
+        return 0
+    except grpc.RpcError:
+        print("AM unreachable (already finished?)", file=sys.stderr)
+        return 1
+
+
+def cmd_history(args: argparse.Namespace) -> int:
+    root = args.dir or default_apps_root()
+    rows = []
+    if os.path.isdir(root):
+        for app_id in sorted(os.listdir(root)):
+            status_path = os.path.join(root, app_id, "status.json")
+            state = "RUNNING?"
+            code = ""
+            if os.path.exists(status_path):
+                with open(status_path) as f:
+                    s = json.load(f)
+                state, code = s["state"], s["exit_code"]
+            rows.append((app_id, state, str(code)))
+    if not rows:
+        print("no applications found")
+        return 0
+    width = max(len(r[0]) for r in rows)
+    for app_id, state, code in rows:
+        print(f"{app_id:<{width}}  {state:<10} {code}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tony", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("submit", help="submit a job and wait for completion")
+    s.add_argument("--conf", help="TOML config file (the tony.xml analogue)")
+    s.add_argument("--src-dir", help="source dir staged into containers")
+    s.add_argument(
+        "-D", "--define", action="append", default=[], metavar="KEY=VALUE",
+        help="config override (repeatable; the -Dtony.k=v analogue)",
+    )
+    s.add_argument("--detach", action="store_true", help="print app id and return")
+    s.add_argument("--quiet", action="store_true")
+    s.set_defaults(fn=cmd_submit)
+
+    s = sub.add_parser("status", help="show application status JSON")
+    s.add_argument("app")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("logs", help="dump task logs")
+    s.add_argument("app")
+    s.add_argument("--task", help="restrict to one task, e.g. worker:0")
+    s.add_argument("--am", action="store_true", help="show the AM log")
+    s.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser("stop", help="stop a running application")
+    s.add_argument("app")
+    s.add_argument("--reason", default="stopped via CLI")
+    s.set_defaults(fn=cmd_stop)
+
+    s = sub.add_parser("history", help="list applications")
+    s.add_argument("--dir", help="apps root (default ~/.tony-tpu/apps)")
+    s.set_defaults(fn=cmd_history)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(level=logging.WARNING)
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""TaskExecutor: in-container bootstrap and user-process supervision.
+
+Deliberately does not import task_executor here: the AM launches it as
+``python -m tony_tpu.executor`` and an eager re-import would double-import
+the module under runpy.
+"""

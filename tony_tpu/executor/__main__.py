@@ -1,0 +1,3 @@
+from tony_tpu.executor.task_executor import main
+
+main()

@@ -1,0 +1,11 @@
+"""Observability: step metrics, resource monitor, profiler glue, portal.
+
+Only the stdlib-only TaskMonitor is exported eagerly; metrics.py imports jax
+at module top, so it is deliberately NOT re-exported here — executors for
+non-JAX frameworks import this package from the metrics thread and must not
+pay (or fail on) a jax import.
+"""
+
+from tony_tpu.obs.monitor import TaskMonitor
+
+__all__ = ["TaskMonitor"]

@@ -1,0 +1,91 @@
+"""TaskMonitor: executor-side resource sampler.
+
+Rebuild of the reference's ``TaskMonitor`` (SURVEY.md section 2): a sampler
+the executor runs beside the user process, pushing samples to the AM's
+metrics RPC. The reference reads /proc for cpu/mem and shells out to
+``nvidia-smi -q -x`` for GPU utilisation; here cpu/mem still come from /proc
+(no psutil dependency) and the accelerator numbers come from the TPU runtime
+metrics JAX exposes, with step-level throughput/MFU reported by the trainer
+through the same channel.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+# name, value, unix-seconds — matches rpc MetricSample
+Sample = tuple[str, float, float]
+
+_CLK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def _proc_stat_jiffies(pid: int) -> float:
+    """utime+stime (+children) of a process, in clock ticks."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(") ", 1)[-1].split()
+        # fields after comm: state is parts[0]; utime=parts[11], stime=parts[12]
+        return float(parts[11]) + float(parts[12])
+    except (OSError, IndexError, ValueError):
+        return 0.0
+
+
+def _proc_rss_bytes(pid: int) -> float:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return float(f.read().split()[1]) * _PAGE
+    except (OSError, IndexError, ValueError):
+        return 0.0
+
+
+def _children(pid: int) -> list[int]:
+    """Direct + transitive children via /proc/<pid>/task/*/children."""
+    out, stack = [], [pid]
+    while stack:
+        p = stack.pop()
+        try:
+            for tid in os.listdir(f"/proc/{p}/task"):
+                path = f"/proc/{p}/task/{tid}/children"
+                try:
+                    with open(path) as f:
+                        kids = [int(c) for c in f.read().split()]
+                except OSError:
+                    continue
+                out.extend(kids)
+                stack.extend(kids)
+        except OSError:
+            continue
+    return out
+
+
+class TaskMonitor:
+    """Samples this process tree's cpu%/rss; extend via ``extra_sources``."""
+
+    def __init__(self, pid: int | None = None):
+        self.pid = pid or os.getpid()
+        self._last_jiffies = 0.0
+        self._last_t = 0.0
+        # callables returning extra samples, e.g. TPU duty cycle
+        self.extra_sources: list = []
+
+    def sample(self) -> list[Sample]:
+        now = time.time()
+        pids = [self.pid, *_children(self.pid)]
+        jiffies = sum(_proc_stat_jiffies(p) for p in pids)
+        rss = sum(_proc_rss_bytes(p) for p in pids)
+        samples: list[Sample] = [("rss_mb", rss / 1e6, now)]
+        if self._last_t > 0 and now > self._last_t:
+            cpu = (jiffies - self._last_jiffies) / _CLK / (now - self._last_t) * 100
+            samples.append(("cpu_percent", max(cpu, 0.0), now))
+        self._last_jiffies, self._last_t = jiffies, now
+        for source in self.extra_sources:
+            try:
+                samples.extend(source())
+            except Exception:
+                pass
+        return samples
+
+
+__all__ = ["Sample", "TaskMonitor"]

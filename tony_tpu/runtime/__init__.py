@@ -1,0 +1,39 @@
+"""Framework runtimes: per-framework cluster bootstrap adapters."""
+
+from tony_tpu.runtime.base import Runtime, TaskIdentity
+from tony_tpu.runtime.frameworks import (
+    HorovodRuntime,
+    MLGenericRuntime,
+    PyTorchRuntime,
+    TFRuntime,
+)
+from tony_tpu.runtime.jax_tpu import JaxTpuRuntime, in_tony_job, initialize
+
+_RUNTIMES = {
+    cls.name: cls
+    for cls in (JaxTpuRuntime, TFRuntime, PyTorchRuntime, HorovodRuntime, MLGenericRuntime)
+}
+
+
+def make_runtime(framework: str) -> Runtime:
+    """Runtime factory keyed by the ``application.framework`` config value."""
+    try:
+        return _RUNTIMES[framework]()
+    except KeyError:
+        raise ValueError(
+            f"unknown framework {framework!r} (expected one of {sorted(_RUNTIMES)})"
+        ) from None
+
+
+__all__ = [
+    "HorovodRuntime",
+    "JaxTpuRuntime",
+    "MLGenericRuntime",
+    "PyTorchRuntime",
+    "Runtime",
+    "TFRuntime",
+    "TaskIdentity",
+    "in_tony_job",
+    "initialize",
+    "make_runtime",
+]

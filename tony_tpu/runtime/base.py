@@ -1,0 +1,80 @@
+"""Runtime adapter interface.
+
+Rebuild of the reference's per-framework ``Framework`` adapter interfaces
+(AMAdapter / TaskExecutorAdapter; SURVEY.md section 2 "Runtime adapters"):
+given the AM-assembled cluster spec and the task's own identity, a runtime
+builds the environment its framework needs to self-organise — TF_CONFIG for
+TensorFlow, MASTER_ADDR/RANK for PyTorch, HOROVOD_* for Horovod, and the
+jax.distributed coordinator contract for JAX (the TPU-native first-class
+path, BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from tony_tpu.config.config import TonyConfig
+
+
+@dataclass(frozen=True)
+class TaskIdentity:
+    """Everything an executor knows about itself after the gang barrier."""
+
+    job_name: str
+    index: int
+    cluster_spec: dict[str, list[str]]   # type -> ["host:port", ...]
+    coordinator_address: str             # rank-0 "host:port"
+    process_id: int                      # global rank (-1 for untracked types)
+    num_processes: int
+    generation: int = 0
+
+    @property
+    def own_address(self) -> str:
+        return self.cluster_spec[self.job_name][self.index]
+
+    @classmethod
+    def from_cluster_spec_response(cls, job_name: str, index: int, resp) -> "TaskIdentity":
+        return cls(
+            job_name=job_name,
+            index=index,
+            cluster_spec=json.loads(resp.spec_json),
+            coordinator_address=resp.coordinator_address,
+            process_id=resp.process_id,
+            num_processes=resp.num_processes,
+            generation=resp.generation,
+        )
+
+
+class Runtime:
+    """Base adapter: subclasses override hooks they need."""
+
+    name = "generic"
+
+    def validate(self, config: TonyConfig) -> None:
+        """Raise on invalid config for this framework (AM-side, pre-schedule)."""
+
+    def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
+        """Env exported into the user training process (executor-side)."""
+        return {
+            "TONY_CLUSTER_SPEC": json.dumps(identity.cluster_spec, sort_keys=True),
+            "TONY_JOB_NAME": identity.job_name,
+            "TONY_TASK_INDEX": str(identity.index),
+            "TONY_COORDINATOR_ADDR": identity.coordinator_address,
+            "TONY_PROCESS_ID": str(identity.process_id),
+            "TONY_NUM_PROCESSES": str(identity.num_processes),
+            "TONY_GENERATION": str(identity.generation),
+        }
+
+    def needs_data_port(self) -> bool:
+        """Whether each task must reserve a data port for the cluster spec.
+
+        True for frameworks whose processes listen on their spec address (TF
+        parameter servers, the JAX coordinator); the executor bind-probes a
+        free port before registering (reference: executor port allocation,
+        SURVEY.md section 5).
+        """
+        return True
+
+
+__all__ = ["Runtime", "TaskIdentity"]

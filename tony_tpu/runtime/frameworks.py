@@ -1,0 +1,113 @@
+"""TF / PyTorch / Horovod / generic runtime adapters.
+
+Parity adapters for the reference's ``TFRuntime`` / ``PyTorchRuntime`` /
+``HorovodRuntime`` / ``MLGenericRuntime`` (SURVEY.md sections 2, 3.2): the
+contract is the *environment* each framework's own bootstrapping reads. The
+data plane stays delegated (TF gRPC, c10d, Horovod controllers) exactly as in
+the reference — on TPU deployments these exist for migration parity and
+CPU-mode tests; the first-class path is JaxTpuRuntime.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tony_tpu.config.config import TonyConfig
+from tony_tpu.runtime.base import Runtime, TaskIdentity
+
+
+class TFRuntime(Runtime):
+    """Exports TF_CONFIG (reference: SURVEY.md section 3.2 step 3).
+
+    ``{"cluster": {"ps": [...], "worker": [...]}, "task": {"type": ..., "index": ...}}``
+    — consumed by tf.distribute (MultiWorkerMirrored / ParameterServerStrategy)
+    and by bare tf.train.Server code.
+    """
+
+    name = "tensorflow"
+
+    def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
+        env = super().build_env(identity, config)
+        env["TF_CONFIG"] = json.dumps(
+            {
+                "cluster": identity.cluster_spec,
+                "task": {"type": identity.job_name, "index": identity.index},
+            },
+            sort_keys=True,
+        )
+        return env
+
+
+class PyTorchRuntime(Runtime):
+    """Exports the torch.distributed env-var init contract.
+
+    MASTER_ADDR/MASTER_PORT point at the rank-0 task's reserved address;
+    RANK/WORLD_SIZE come from the AM rank table; LOCAL_RANK is 0 because the
+    substrate schedules one process per container (the reference does the
+    same — one executor per container).
+    """
+
+    name = "pytorch"
+
+    def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
+        env = super().build_env(identity, config)
+        host, _, port = identity.coordinator_address.rpartition(":")
+        env.update(
+            {
+                "MASTER_ADDR": host,
+                "MASTER_PORT": port,
+                "RANK": str(identity.process_id),
+                "WORLD_SIZE": str(identity.num_processes),
+                "LOCAL_RANK": "0",
+            }
+        )
+        return env
+
+
+class HorovodRuntime(Runtime):
+    """Horovod env-contract parity, rendezvous-free.
+
+    The reference runs an AM-side python driver hosting a Gloo rendezvous
+    server and exports HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT plus rank vars
+    (SURVEY.md section 3.4). Here the AM-assigned rank table already provides
+    everything the rendezvous would compute, so only the env contract
+    remains: HOROVOD_RANK/SIZE/LOCAL_*/CROSS_* plus controller/cpu-ops
+    selection. On TPU the ring-allreduce itself is replaced by lax.psum over
+    ICI (the BASELINE.json mapping), which needs no Horovod at all — this
+    adapter exists for migrating jobs still importing horovod in CPU mode.
+    """
+
+    name = "horovod"
+
+    def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
+        env = super().build_env(identity, config)
+        host, _, port = identity.coordinator_address.rpartition(":")
+        # one slot per container -> local size 1, cross size == world size
+        env.update(
+            {
+                "HOROVOD_CONTROLLER": "gloo",
+                "HOROVOD_CPU_OPERATIONS": "gloo",
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": host,
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": port,
+                "HOROVOD_RANK": str(identity.process_id),
+                "HOROVOD_SIZE": str(identity.num_processes),
+                "HOROVOD_LOCAL_RANK": "0",
+                "HOROVOD_LOCAL_SIZE": "1",
+                "HOROVOD_CROSS_RANK": str(identity.process_id),
+                "HOROVOD_CROSS_SIZE": str(identity.num_processes),
+                "HOROVOD_HOSTNAME": identity.own_address.rpartition(":")[0],
+            }
+        )
+        return env
+
+
+class MLGenericRuntime(Runtime):
+    """No framework assumptions: just the TONY_* cluster env (base class)."""
+
+    name = "generic"
+
+    def needs_data_port(self) -> bool:
+        return True
+
+
+__all__ = ["HorovodRuntime", "MLGenericRuntime", "PyTorchRuntime", "TFRuntime"]

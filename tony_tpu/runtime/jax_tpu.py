@@ -1,0 +1,87 @@
+"""JaxTpuRuntime: the first-class TPU-native framework runtime.
+
+The north star (BASELINE.json): "TaskExecutor bootstraps
+``jax.distributed.initialize`` with the AM-assigned coordinator address and
+process_id instead of exporting TF_CONFIG/HOROVOD_*". The coordinator is the
+rank-0 task's registered address (the executor reserved that port, so the JAX
+coordination service in the rank-0 user process can bind it); the data plane
+is XLA collectives over ICI/DCN — no NCCL/Gloo surface exists.
+
+User scripts call :func:`initialize` (or just read the env themselves):
+
+    import tony_tpu.runtime.jax_tpu as rt
+    rt.initialize()          # no-op outside a tony-tpu job
+    ... jax code; jax.process_index() == TONY_PROCESS_ID ...
+"""
+
+from __future__ import annotations
+
+import os
+
+from tony_tpu.config.config import TonyConfig
+from tony_tpu.runtime.base import Runtime, TaskIdentity
+
+ENV_COORDINATOR = "TONY_COORDINATOR_ADDR"
+ENV_PROCESS_ID = "TONY_PROCESS_ID"
+ENV_NUM_PROCESSES = "TONY_NUM_PROCESSES"
+
+
+class JaxTpuRuntime(Runtime):
+    name = "jax"
+
+    def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
+        env = super().build_env(identity, config)
+        # Also export JAX's own spellings so scripts that never import
+        # tony_tpu still work: jax.distributed.initialize() with no args
+        # reads JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID.
+        env.update(
+            {
+                "JAX_COORDINATOR_ADDRESS": identity.coordinator_address,
+                "JAX_NUM_PROCESSES": str(identity.num_processes),
+                "JAX_PROCESS_ID": str(identity.process_id),
+            }
+        )
+        return env
+
+
+def in_tony_job() -> bool:
+    return ENV_COORDINATOR in os.environ
+
+
+def initialize(**kwargs) -> None:
+    """Bootstrap jax.distributed from the tony-tpu env; no-op standalone.
+
+    Safe to call unconditionally at the top of a training script: outside a
+    tony-tpu job (or in a single-process job) it does nothing, so the same
+    script runs under ``tony submit`` and bare ``python``.
+    """
+    if not in_tony_job():
+        return
+    num = int(os.environ[ENV_NUM_PROCESSES])
+    if num <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=os.environ[ENV_COORDINATOR],
+        num_processes=num,
+        process_id=int(os.environ[ENV_PROCESS_ID]),
+        **kwargs,
+    )
+
+
+def process_id() -> int:
+    return int(os.environ.get(ENV_PROCESS_ID, "0"))
+
+
+def num_processes() -> int:
+    return int(os.environ.get(ENV_NUM_PROCESSES, "1"))
+
+
+__all__ = [
+    "JaxTpuRuntime",
+    "in_tony_job",
+    "initialize",
+    "num_processes",
+    "process_id",
+]
