@@ -26,7 +26,7 @@ def run_bench() -> dict:
 
     on_tpu = jax.devices()[0].platform != "cpu"
     if on_tpu:
-        cfg = LlamaConfig.bench_410m()
+        cfg = LlamaConfig.bench_410m(attention_impl="flash")
         batch, seq, steps = 8, 2048, 10
     else:  # CPU fallback so the driver always gets a line
         cfg = LlamaConfig.tiny()

@@ -1,0 +1,61 @@
+"""PyTorch DDP example (milestone config #3 shape, gloo on CPU).
+
+The reference's Horovod ring-allreduce BERT job maps to torch.distributed
+DDP here: the AM-assigned env (MASTER_ADDR/PORT, RANK, WORLD_SIZE — exported
+by PyTorchRuntime) drives torch's env:// rendezvous, and the allreduce rides
+gloo on CPU. On TPU the same model family runs through the JAX path
+(tony_tpu.models + lax.psum over ICI, the BASELINE.json mapping) — this
+script is the migration-parity lane for existing torch jobs.
+
+Submit:  python -m tony_tpu.cli submit --conf examples/bert_pytorch/tony.toml \
+             --src-dir examples/bert_pytorch
+"""
+
+import os
+
+import torch
+import torch.distributed as dist
+import torch.nn as nn
+
+
+class TinyBertBlock(nn.Module):
+    """One transformer encoder block at BERT-base width (compute shape only)."""
+
+    def __init__(self, dim=768, heads=12):
+        super().__init__()
+        self.attn = nn.MultiheadAttention(dim, heads, batch_first=True)
+        self.ln1 = nn.LayerNorm(dim)
+        self.ff = nn.Sequential(nn.Linear(dim, 3072), nn.GELU(), nn.Linear(3072, dim))
+        self.ln2 = nn.LayerNorm(dim)
+
+    def forward(self, x):
+        a, _ = self.attn(x, x, x, need_weights=False)
+        x = self.ln1(x + a)
+        return self.ln2(x + self.ff(x))
+
+
+def main() -> None:
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    dist.init_process_group("gloo", rank=rank, world_size=world)
+    torch.manual_seed(0)
+
+    model = nn.Sequential(TinyBertBlock(), nn.Linear(768, 2))
+    ddp = nn.parallel.DistributedDataParallel(model)
+    opt = torch.optim.AdamW(ddp.parameters(), lr=1e-4)
+    loss_fn = nn.CrossEntropyLoss()
+
+    for step in range(5):
+        x = torch.randn(4, 32, 768)
+        y = torch.randint(0, 2, (4,))
+        opt.zero_grad()
+        out = ddp(x).mean(dim=1)
+        loss = loss_fn(out, y)
+        loss.backward()  # gloo allreduce happens here
+        opt.step()
+    print(f"rank {rank}/{world}: final loss {loss.item():.4f}")
+    dist.destroy_process_group()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Flagship example: distributed Llama pretraining under `tony submit`.
+
+Reference parity: tony-examples' mnist-tensorflow / horovod jobs were the
+"real training" samples (SURVEY.md section 2 "tony-examples"); this is the
+TPU-era equivalent — the same script runs single-chip or multi-host purely
+by config (milestone config #4: multi-host JAX Llama DP).
+
+Submit:
+    python -m tony_tpu.cli submit --conf examples/llama_pretrain/tony.toml \
+        --src-dir examples/llama_pretrain
+Standalone (single chip):
+    python examples/llama_pretrain/train.py --preset tiny --steps 20
+"""
+
+import argparse
+import logging
+import os
+
+import jax
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="tiny",
+                   choices=["tiny", "bench_410m", "llama2_7b", "llama3_8b"])
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--global-batch", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--checkpoint-dir", default=os.environ.get("TONY_CHECKPOINT_DIR", ""))
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--attention", default="", help="dot | flash | ring")
+    args = p.parse_args()
+
+    # jax.distributed bootstrap happens inside fit() via the TONY_* env.
+    from tony_tpu.models.llama import LlamaConfig
+    from tony_tpu.train import DataConfig, FitConfig, fit
+
+    model = getattr(LlamaConfig, args.preset)()
+    if args.attention:
+        from dataclasses import replace
+
+        model = replace(model, attention_impl=args.attention)
+    final = fit(
+        FitConfig(
+            model=model,
+            data=DataConfig(
+                global_batch=args.global_batch,
+                seq_len=args.seq_len,
+                vocab_size=model.vocab_size,
+            ),
+            steps=args.steps,
+            log_every=max(args.steps // 10, 1),
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+        )
+    )
+    if jax.process_index() == 0:
+        print("final:", final)
+
+
+if __name__ == "__main__":
+    main()

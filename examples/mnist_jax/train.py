@@ -1,0 +1,67 @@
+"""MNIST-shaped MLP classifier (milestone config #1, JAX edition).
+
+The reference's canonical smoke job is single-worker TensorFlow MNIST via
+CLI submit (BASELINE.json configs[0]); this is the same job on the
+first-class JAX runtime. The environment is zero-egress, so the dataset is a
+deterministic synthetic stand-in with the same (28x28 -> 10) shape; swap
+``load_data`` for real MNIST arrays where a download cache exists.
+
+Submit:  python -m tony_tpu.cli submit --conf examples/mnist_jax/tony.toml \
+             --src-dir examples/mnist_jax
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import tony_tpu.runtime.jax_tpu as rt
+
+
+def load_data(n=4096, seed=0):
+    """Synthetic 10-class 'digits': class-dependent blob patterns + noise."""
+    rng = np.random.default_rng(seed)
+    protos = rng.normal(0, 1, (10, 784)).astype(np.float32)
+    labels = rng.integers(0, 10, n)
+    x = protos[labels] + rng.normal(0, 2.0, (n, 784)).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(labels)
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    rt.initialize()  # no-op standalone; multi-proc under tony submit
+
+    x, y = load_data()
+    params = {
+        "w1": jax.random.normal(jax.random.key(0), (784, 128)) * 0.05,
+        "b1": jnp.zeros(128),
+        "w2": jax.random.normal(jax.random.key(1), (128, 10)) * 0.05,
+        "b2": jnp.zeros(10),
+    }
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, xb, yb):
+        h = jax.nn.relu(xb @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+    @jax.jit
+    def step(p, s, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        updates, s = opt.update(grads, s)
+        return optax.apply_updates(p, updates), s, loss
+
+    loss = None
+    for i in range(100):
+        idx = jax.random.randint(jax.random.key(i), (256,), 0, x.shape[0])
+        params, opt_state, loss = step(params, opt_state, x[idx], y[idx])
+    final = float(loss)
+    print(f"process {rt.process_id()}: final loss {final:.4f}")
+    assert final < 1.5, "training diverged"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""TF ps/worker example (milestone config #2 shape, CPU mode).
+
+The reference's ParameterServerStrategy job: TonY exports TF_CONFIG and
+TensorFlow self-organises (SURVEY.md section 3.2). Same contract here via
+TFRuntime. ps tasks run tf.distribute's coordinator-less server; workers
+train a small classifier on synthetic data (zero-egress environment).
+
+Submit:  python -m tony_tpu.cli submit --conf examples/mnist_tf/tony.toml \
+             --src-dir examples/mnist_tf
+"""
+
+import json
+import os
+
+
+def main() -> None:
+    tf_config = json.loads(os.environ["TF_CONFIG"])
+    task = tf_config["task"]
+    print(f"TF task {task['type']}:{task['index']} cluster={sorted(tf_config['cluster'])}")
+
+    import tensorflow as tf
+
+    if task["type"] == "ps":
+        # Parameter servers block serving variables until the job ends; the
+        # AM marks ps untracked so worker completion finishes the job.
+        server = tf.distribute.Server(
+            tf.train.ClusterSpec(tf_config["cluster"]),
+            job_name="ps",
+            task_index=task["index"],
+        )
+        server.join()
+        return
+
+    # Worker: plain in-process training (MultiWorker/PS strategies need
+    # >1 real host to be meaningful; the env contract is what's under test).
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2048, 784)).astype("float32")
+    y = rng.integers(0, 10, 2048)
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(64, activation="relu"), tf.keras.layers.Dense(10)]
+    )
+    model.compile(
+        optimizer="adam",
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+    )
+    hist = model.fit(x, y, epochs=1, batch_size=128, verbose=0)
+    print("final loss:", hist.history["loss"][-1])
+
+
+if __name__ == "__main__":
+    main()

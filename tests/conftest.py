@@ -24,3 +24,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_default_mesh():
+    """The default mesh is process-global (build_mesh registers it); reset
+    between tests so a mesh from one test can't leak into another's model
+    hooks (attention_impl='flash'/'ring')."""
+    yield
+    from tony_tpu.parallel.mesh import set_default_mesh
+
+    set_default_mesh(None)

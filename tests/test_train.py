@@ -1,0 +1,76 @@
+"""Tests for the training loop, data pipeline, and checkpoint glue."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models.llama import LlamaConfig
+from tony_tpu.parallel.mesh import MeshShape
+from tony_tpu.train import DataConfig, FitConfig, fit
+
+
+def test_synthetic_data_shapes_and_determinism():
+    from tony_tpu.train.data import synthetic_batches
+
+    cfg = DataConfig(global_batch=4, seq_len=16, vocab_size=97, seed=3)
+    a = next(synthetic_batches(cfg))
+    b = next(synthetic_batches(cfg))
+    assert a[0].shape == (4, 16) and a[1].shape == (4, 16)
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    # targets are inputs shifted by one
+    src = next(synthetic_batches(cfg))
+    np.testing.assert_array_equal(np.asarray(src[0][:, 1:]), np.asarray(src[1][:, :-1]))
+
+
+def test_mmap_data_roundtrip(tmp_path):
+    from tony_tpu.train.data import mmap_batches
+
+    tokens = np.arange(4 * (8 + 1) * 3, dtype=np.int32)
+    path = tmp_path / "tokens.bin"
+    tokens.tofile(path)
+    cfg = DataConfig(global_batch=4, seq_len=8, path=str(path))
+    inputs, targets = next(mmap_batches(cfg))
+    assert inputs.shape == (4, 8)
+    np.testing.assert_array_equal(np.asarray(inputs)[0], tokens[:8])
+    np.testing.assert_array_equal(np.asarray(targets)[0], tokens[1:9])
+
+
+def test_fit_loss_decreases_tiny_model(tmp_path):
+    cfg = FitConfig(
+        model=LlamaConfig.tiny(),
+        data=DataConfig(global_batch=4, seq_len=32, vocab_size=256),
+        mesh_shape=MeshShape(dp=2, fsdp=2, tp=2, sp=1),
+        steps=40,
+        log_every=20,
+        lr=5e-3,
+        warmup_steps=2,
+    )
+    final = fit(cfg)
+    assert np.isfinite(final["final_loss"])
+    # Zipf synthetic data: loss must drop below the uniform ceiling ln(256)=5.55
+    assert final["final_loss"] < 5.2
+
+
+def test_fit_checkpoint_resume(tmp_path):
+    common = dict(
+        model=LlamaConfig.tiny(),
+        data=DataConfig(global_batch=4, seq_len=32, vocab_size=256),
+        mesh_shape=MeshShape(fsdp=2),
+        log_every=5,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=5,
+    )
+    fit(FitConfig(steps=5, **common))
+    from tony_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.latest_step() == 5
+    mgr.close()
+    # resume continues to 10 without error and saves step 10
+    fit(FitConfig(steps=10, **common))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.latest_step() == 10
+    mgr.close()

@@ -187,9 +187,9 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     ).astype(x.dtype)
 
 
-def dot_attention(q: jax.Array, k: jax.Array, v: jax.Array, cfg: LlamaConfig) -> jax.Array:
+def dot_attention(q: jax.Array, k: jax.Array, v: jax.Array, cfg: LlamaConfig | None = None) -> jax.Array:
     """Plain causal attention, fp32 softmax. q:[B,S,H,hd] k/v:[B,S,H,hd]."""
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     S = q.shape[1]
     causal = jnp.tril(jnp.ones((S, S), dtype=bool))
@@ -203,9 +203,9 @@ def _get_attention(cfg: LlamaConfig) -> AttnFn:
         return dot_attention
     try:
         if cfg.attention_impl == "flash":
-            from tony_tpu.ops.attention import flash_attention
+            from tony_tpu.ops.attention import sharded_flash_attention
 
-            return flash_attention
+            return sharded_flash_attention
         if cfg.attention_impl == "ring":
             from tony_tpu.parallel.ring_attention import ring_attention
 

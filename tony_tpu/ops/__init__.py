@@ -1,0 +1,5 @@
+"""TPU kernels (Pallas) with interpreter-mode CPU fallbacks."""
+
+from tony_tpu.ops.attention import flash_attention
+
+__all__ = ["flash_attention"]

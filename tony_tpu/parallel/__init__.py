@@ -1,0 +1,56 @@
+"""Parallelism library: mesh, sharding rules, DP/FSDP/TP/SP/PP/EP.
+
+The training-side layer the reference delegated to user frameworks
+(SURVEY.md section 2 "Parallelism strategies"), built TPU-first: one mesh,
+logical-axis sharding rules, and compiled XLA collectives.
+"""
+
+from tony_tpu.parallel.mesh import (
+    MESH_AXES,
+    MeshShape,
+    build_mesh,
+    default_shape,
+    get_default_mesh,
+    set_default_mesh,
+    single_device_mesh,
+)
+from tony_tpu.parallel.moe import MoEConfig, init_moe_params, moe_block
+from tony_tpu.parallel.pipeline import (
+    microbatch,
+    pipeline_apply,
+    pipeline_local,
+    unmicrobatch,
+)
+from tony_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    ring_attention,
+    ring_attention_local,
+)
+from tony_tpu.parallel.sharding import DEFAULT_RULES, Rules, spec_for, tree_shardings
+from tony_tpu.parallel.ulysses import make_ulysses_attention, ulysses_attention_local
+
+__all__ = [
+    "DEFAULT_RULES",
+    "MESH_AXES",
+    "MeshShape",
+    "MoEConfig",
+    "Rules",
+    "build_mesh",
+    "default_shape",
+    "get_default_mesh",
+    "init_moe_params",
+    "make_ring_attention",
+    "make_ulysses_attention",
+    "microbatch",
+    "moe_block",
+    "pipeline_apply",
+    "pipeline_local",
+    "ring_attention",
+    "ring_attention_local",
+    "set_default_mesh",
+    "single_device_mesh",
+    "spec_for",
+    "tree_shardings",
+    "ulysses_attention_local",
+    "unmicrobatch",
+]

@@ -65,6 +65,20 @@ def default_shape(n_devices: int, *, tp: int = 1, sp: int = 1) -> MeshShape:
     return MeshShape(dp=1, fsdp=n_devices // (tp * sp), tp=tp, sp=sp)
 
 
+# The mesh model-level hooks (attention_impl='ring'/'flash') resolve against;
+# build_mesh registers every mesh it constructs.
+_DEFAULT_MESH: Mesh | None = None
+
+
+def set_default_mesh(mesh: Mesh | None) -> None:
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+
+
+def get_default_mesh() -> Mesh | None:
+    return _DEFAULT_MESH
+
+
 def build_mesh(shape: MeshShape | None = None, devices: list | None = None) -> Mesh:
     """Build a ``jax.sharding.Mesh`` with the canonical axis names.
 
@@ -77,17 +91,31 @@ def build_mesh(shape: MeshShape | None = None, devices: list | None = None) -> M
         devices = jax.devices()
     if shape is None:
         shape = default_shape(len(devices))
-    if shape.n_devices != len(devices):
+    if shape.n_devices > len(devices):
         raise ValueError(
             f"mesh shape {shape.sizes} needs {shape.n_devices} devices, "
             f"got {len(devices)}"
         )
+    if shape.n_devices < len(devices):
+        # Legitimate for tests (sub-meshes of the virtual CPU set) but almost
+        # certainly a stale config in production — say so loudly.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "mesh shape %s uses only %d of %d devices; %d idle",
+            shape.sizes, shape.n_devices, len(devices), len(devices) - shape.n_devices,
+        )
+    devices = list(devices)[: shape.n_devices]
     try:
         dev_array = mesh_utils.create_device_mesh(shape.sizes, devices=devices)
     except (ValueError, AssertionError):
         # Virtual/CPU device sets lack topology metadata; fall back to raveled order.
         dev_array = np.asarray(devices).reshape(shape.sizes)
-    return Mesh(dev_array, MESH_AXES)
+    mesh = Mesh(dev_array, MESH_AXES)
+    # Register as the default mesh for model-level hooks (e.g.
+    # LlamaConfig(attention_impl='ring'/'flash') resolves its mesh here).
+    set_default_mesh(mesh)
+    return mesh
 
 
 def single_device_mesh() -> Mesh:

@@ -1,0 +1,132 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Absent from the reference (SURVEY.md section 2 parallelism table: EP "—").
+TPU-native formulation (GShard/Switch style, arXiv:2006.16668): routing is
+expressed as dense one-hot dispatch/combine einsums — MXU-friendly, static
+shapes (fixed expert capacity, overflow tokens dropped) — and the expert dim
+is a logical axis ("expert") that the sharding rules map onto a mesh axis.
+With expert weights sharded over that axis, XLA lowers the dispatch/combine
+einsums into the all-to-all exchange that dedicated EP backends hand-write.
+
+All routing statistics are float32; expert FFN compute follows the input
+dtype (bf16 on TPU).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    dim: int
+    ffn_dim: int
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert token slots; static given the (padded) token count."""
+        return max(
+            1,
+            int(math.ceil(self.capacity_factor * self.top_k * n_tokens / self.n_experts)),
+        )
+
+
+def logical_axes() -> dict[str, tuple[str | None, ...]]:
+    """Sharding names; "expert" maps to a mesh axis via the rules table."""
+    return {
+        "router": ("embed", "expert"),
+        "w1": ("expert", "embed", "ffn"),
+        "w3": ("expert", "embed", "ffn"),
+        "w2": ("expert", "ffn", "embed"),
+    }
+
+
+def init_moe_params(rng: jax.Array, cfg: MoEConfig, dtype=jnp.bfloat16) -> dict[str, Any]:
+    k0, k1, k2, k3 = jax.random.split(rng, 4)
+    d, f, e = cfg.dim, cfg.ffn_dim, cfg.n_experts
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "router": dense(k0, (d, e), d).astype(jnp.float32),  # routing in fp32
+        "w1": dense(k1, (e, d, f), d),
+        "w3": dense(k2, (e, d, f), d),
+        "w2": dense(k3, (e, f, d), f),
+    }
+
+
+def _top_k_dispatch(probs: jax.Array, cfg: MoEConfig, capacity: int):
+    """Build dispatch/combine tensors from router probabilities.
+
+    probs: [T, E] float32. Returns (dispatch [T,E,C] in {0,1}, combine
+    [T,E,C] fp32 gates, aux_loss scalar). Tokens beyond an expert's capacity
+    are dropped (their combine weight is zero), the Switch/GShard contract.
+    """
+    T, E = probs.shape
+    remaining = probs
+    # occupancy count per expert, accumulated across the k rounds
+    occupancy = jnp.zeros((E,), jnp.int32)
+    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    importance = jnp.zeros((E,), probs.dtype)  # fraction routed, for aux loss
+
+    for _ in range(cfg.top_k):
+        idx = jnp.argmax(remaining, axis=-1)                      # [T]
+        gate = jnp.take_along_axis(remaining, idx[:, None], -1)[:, 0]
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)        # [T,E]
+        # position of each token in its expert's queue this round, offset by
+        # seats taken in earlier rounds
+        pos_in_round = jnp.cumsum(onehot, axis=0) - onehot        # [T,E]
+        pos = pos_in_round + occupancy[None, :]
+        within = (pos < capacity) & (onehot > 0)
+        pos_clipped = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+        slot = jax.nn.one_hot(pos_clipped, capacity, dtype=probs.dtype)  # [T,E,C]
+        sel = (within.astype(probs.dtype))[..., None] * slot
+        dispatch = dispatch + sel
+        combine = combine + gate[:, None, None] * sel
+        occupancy = occupancy + jnp.sum(onehot, axis=0).astype(jnp.int32)
+        importance = importance + jnp.mean(onehot, axis=0)
+        remaining = remaining * (1.0 - onehot)                    # mask chosen
+
+    # load-balancing aux loss (Switch eq. 4): E * sum(frac_routed * mean_prob)
+    aux = cfg.n_experts * jnp.sum(importance / cfg.top_k * jnp.mean(probs, axis=0))
+    # renormalise combine weights over the selected experts
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine, aux
+
+
+def moe_block(params: dict[str, Any], x: jax.Array, cfg: MoEConfig):
+    """MoE SwiGLU FFN. x: [B, S, D] -> (y [B, S, D], aux_loss scalar).
+
+    Dropped (over-capacity) tokens pass through with a zero FFN delta, so the
+    residual connection outside this block keeps their representation.
+    """
+    B, S, D = x.shape
+    T = B * S
+    flat = x.reshape(T, D)
+    capacity = cfg.capacity(T)
+
+    logits = flat.astype(jnp.float32) @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, aux = _top_k_dispatch(probs, cfg, capacity)
+
+    # [T,E,C]x[T,D] -> [E,C,D]: the EP all-to-all happens inside this einsum
+    # when "expert" is mesh-sharded.
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), flat)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))
+    h = h * jnp.einsum("ecd,edf->ecf", expert_in, params["w3"])
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return y.reshape(B, S, D), aux
+
+
+__all__ = ["MoEConfig", "init_moe_params", "logical_axes", "moe_block"]

@@ -1,0 +1,127 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``pp`` mesh axis.
+
+Not present in the reference (SURVEY.md section 2 parallelism table: PP "—").
+TPU-native design: stages are devices along a mesh axis, activations hop
+stage-to-stage with ``lax.ppermute`` (one ICI neighbour hop), and the
+microbatch schedule is a single ``lax.fori_loop`` — compiled once, no
+data-dependent Python control flow. The bubble is the standard GPipe
+(P-1)/(M+P-1) fraction; raise ``n_microbatches`` to amortise.
+
+Usage (inside or outside jit):
+
+    stages = stack_stage_params(per_stage_params)      # leading dim = P
+    y = pipeline_apply(stage_fn, stages, x_microbatched, mesh=mesh)
+
+where ``stage_fn(stage_params, x) -> y`` maps one microbatch through one
+stage, and x_microbatched has shape [M, mb, ...].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def pipeline_local(
+    stage_fn: StageFn,
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Per-device GPipe schedule; call inside shard_map.
+
+    ``x``: [M, mb, ...] microbatched input, replicated over the axis (only
+    stage 0 reads it). Returns [M, mb, ...] outputs, replicated (the last
+    stage's results are broadcast with a psum).
+    """
+    n_stages = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    M = x.shape[0]
+    n_ticks = M + n_stages - 1
+    # stage s receives from s-1; the (n-1 -> 0) edge carries garbage that
+    # stage 0 never reads (it pulls from x), but keeps the perm a bijection.
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def probe_out():
+        """Output structure for one microbatch (to size the buffers)."""
+        return jax.eval_shape(lambda p, b: stage_fn(p, b), stage_params, x[0])
+
+    out_shape = probe_out()
+    # pcast marks the zero buffers as device-varying along the pipeline axis
+    # (jax>=0.9 shard_map typing: loop carries must match the outputs, which
+    # become varying after ppermute/psum).
+    recv0 = lax.pcast(
+        jnp.zeros(out_shape.shape, out_shape.dtype), (axis_name,), to="varying"
+    )
+    out0 = lax.pcast(
+        jnp.zeros((M, *out_shape.shape), out_shape.dtype), (axis_name,), to="varying"
+    )
+
+    def tick(t, carry):
+        recv, out = carry
+        feed_idx = jnp.clip(t, 0, M - 1)
+        first_stage_in = lax.dynamic_index_in_dim(x, feed_idx, 0, keepdims=False)
+        cur = jnp.where(my == 0, first_stage_in.astype(recv.dtype), recv)
+        y = stage_fn(stage_params, cur)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+        updated = lax.dynamic_update_index_in_dim(out, y, out_idx, 0)
+        out = jnp.where(t >= n_stages - 1, updated, out)
+        recv = lax.ppermute(y, axis_name, perm)
+        return recv, out
+
+    _, out = lax.fori_loop(0, n_ticks, tick, (recv0, out0))
+    # Broadcast the last stage's buffer to every stage.
+    out = jnp.where(my == n_stages - 1, out, jnp.zeros_like(out))
+    return lax.psum(out, axis_name)
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stacked_stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Full-array entry: shard stage params over ``axis_name``, run the
+    schedule, return outputs for all microbatches (replicated over the axis).
+
+    ``stacked_stage_params``: pytree whose leaves have a leading stage dim of
+    size mesh.shape[axis_name].
+    """
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_stage_params)
+
+    def body(params, xs):
+        params = jax.tree.map(lambda a: a[0], params)  # drop unit stage dim
+        return pipeline_local(stage_fn, params, xs, axis_name=axis_name)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+    )(stacked_stage_params, x)
+
+
+def microbatch(x: jax.Array, n_microbatches: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...]."""
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by n_microbatches={n_microbatches}")
+    return x.reshape(n_microbatches, B // n_microbatches, *x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [M*mb, ...]."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
+__all__ = ["microbatch", "pipeline_apply", "pipeline_local", "unmicrobatch"]

@@ -1,0 +1,135 @@
+"""Ring attention: sequence/context parallelism over an ICI ring.
+
+Absent from the reference in any form (SURVEY.md section 5 "Long-context":
+TonY never touches sequence length); required here as a first-class library
+layer. Design follows the blockwise/ring-attention pattern (Liu et al.,
+arXiv:2310.01889) expressed the TPU way: the sequence axis is sharded over
+the ``sp`` mesh axis, K/V chunks rotate around the ring with
+``lax.ppermute`` (one ICI hop per step), and each device folds incoming
+chunks into an online-softmax accumulator — peak memory per device is
+O(S/n), compute overlaps with the permute because XLA pipelines the loop.
+
+Numerics: scores and the softmax accumulator are float32 regardless of input
+dtype; masked positions use a large-negative filler instead of -inf so fully
+masked chunks stay NaN-free (the j=0 diagonal chunk always has unmasked
+entries, which seeds the running max with a finite value).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _chunk_scores(q, k, scale, q_start, k_start, causal):
+    """fp32 scores [B,H,Sq,Sk] with causal mask at global positions."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        q_pos = q_start + jnp.arange(q.shape[1])
+        k_pos = k_start + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    return s
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Per-device ring attention; call inside shard_map.
+
+    q, k, v: [B, S_local, H, head_dim] — this device's contiguous sequence
+    chunk (chunk index == its position along ``axis_name``). Returns the
+    attention output for the local queries, exact (not approximate).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Derive the accumulators from q (not jnp.zeros) so they carry the same
+    # varying-manual-axes type as the loop outputs (jax>=0.9 shard_map typing).
+    zero = jnp.swapaxes(q.astype(jnp.float32).sum(-1), 1, 2) * 0.0  # [B,H,S]
+    o0 = jnp.broadcast_to(zero[..., None], (B, H, S, D))
+    m0 = zero + _NEG
+    l0 = zero
+
+    def body(j, carry):
+        k_cur, v_cur, o, m, l = carry
+        kv_idx = (my - j) % n  # which chunk this device holds at step j
+        s = _chunk_scores(q, k_cur, scale, my * S, kv_idx * S, causal)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        # rotate K/V one step around the ring (ICI-neighbour hop)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return k_next, v_next, o, m_new, l
+
+    _, _, o, _, l = lax.fori_loop(0, n, body, (k, v, o0, m0, l0))
+    out = o / l[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh, *, axis_name: str = "sp", causal: bool = True
+):
+    """AttnFn closure: full arrays in, shard_map over the mesh inside.
+
+    Batch goes over dp/fsdp, sequence over ``axis_name``, heads over tp (all
+    only if present in the mesh); the ring collective runs over ``axis_name``.
+    Plugs into llama.LlamaConfig(attention_impl='ring') via set_default_mesh.
+    """
+    from tony_tpu.parallel.sharding import attn_spec
+
+    spec = attn_spec(mesh, seq_axis=axis_name)
+    inner = partial(ring_attention_local, axis_name=axis_name, causal=causal)
+
+    def attn(q, k, v, cfg=None):
+        return jax.shard_map(
+            lambda a, b, c: inner(a, b, c),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )(q, k, v)
+
+    return attn
+
+
+def ring_attention(q, k, v, cfg=None):
+    """Model hook (AttnFn signature): uses the registered default mesh."""
+    from tony_tpu.parallel.mesh import get_default_mesh
+
+    mesh = get_default_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "ring attention needs a mesh: call "
+            "tony_tpu.parallel.set_default_mesh(mesh) (build_mesh does this)"
+        )
+    return make_ring_attention(mesh)(q, k, v, cfg)
+
+
+__all__ = [
+    "make_ring_attention",
+    "ring_attention",
+    "ring_attention_local",
+]

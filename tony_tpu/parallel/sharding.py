@@ -56,6 +56,20 @@ def spec_for(logical_axes: tuple[str | None, ...], rules: Rules = DEFAULT_RULES)
     return P(*parts)
 
 
+def attn_spec(mesh: Mesh, seq_axis: str | None = None) -> P:
+    """PartitionSpec for [B, S, H, head_dim] attention activations.
+
+    Batch over dp/fsdp, heads over tp (each only if present in the mesh),
+    sequence over ``seq_axis`` when given (ring/Ulysses context parallelism).
+    Shared by every AttnFn wrapper so the sharding policy lives in one place.
+    """
+    axes = set(mesh.axis_names)
+    batch = tuple(a for a in ("dp", "fsdp") if a in axes) or None
+    heads = "tp" if "tp" in axes else None
+    seq = seq_axis if seq_axis in axes else None
+    return P(batch, seq, heads, None)
+
+
 def tree_shardings(
     logical_tree: Any, mesh: Mesh, rules: Rules = DEFAULT_RULES
 ) -> Any:

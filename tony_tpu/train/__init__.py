@@ -1,0 +1,23 @@
+"""Training library: sharded train step, loop, data, checkpointing."""
+
+from tony_tpu.train.data import DataConfig, make_batches
+from tony_tpu.train.loop import FitConfig, fit
+from tony_tpu.train.trainer import (
+    TrainState,
+    default_optimizer,
+    make_train_state,
+    make_train_step,
+    state_shardings,
+)
+
+__all__ = [
+    "DataConfig",
+    "FitConfig",
+    "TrainState",
+    "default_optimizer",
+    "fit",
+    "make_batches",
+    "make_train_state",
+    "make_train_step",
+    "state_shardings",
+]

@@ -1,0 +1,83 @@
+"""Checkpoint/resume glue: orbax async multi-host checkpointing.
+
+The reference does NOT checkpoint model state — that is the user script's
+job; TonY contributes restart orchestration + durable paths (SURVEY.md
+section 5 "Checkpoint/resume"). This module keeps the same separation but
+ships the glue first-class: a CheckpointManager wired to the AM's restart
+path, so a gang-restarted job resumes at the last step (milestone config #5).
+
+Works single-process and multi-process (orbax coordinates across
+jax.distributed automatically; saves are async so the train loop never
+blocks on HBM->disk).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Thin orbax wrapper bound to a directory and keep policy."""
+
+    def __init__(self, directory: str, *, keep: int = 3, save_interval_steps: int = 0):
+        self.directory = directory
+        self._interval = save_interval_steps
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                save_interval_steps=max(save_interval_steps, 1),
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def should_save(self, step: int) -> bool:
+        return self._interval > 0 and step % self._interval == 0
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Async save; returns whether a save was started."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+
+    def restore(self, state_template: Any, step: int | None = None) -> tuple[Any, int]:
+        """Restore the latest (or given) step into the template's shardings.
+
+        Returns (state, step); (template, -1) when no checkpoint exists —
+        the caller starts from scratch.
+        """
+        target = step if step is not None else self.latest_step()
+        if target is None or target < 0:
+            return state_template, -1
+        restored = self._mgr.restore(
+            target,
+            args=ocp.args.StandardRestore(jax.tree.map(_as_restore_leaf, state_template)),
+        )
+        return restored, target
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        """Block until in-flight async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def _as_restore_leaf(x: Any) -> Any:
+    """Restore into abstract shaped leaves so orbax re-shards on load."""
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    return x
+
+
+__all__ = ["CheckpointManager"]

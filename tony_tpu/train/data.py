@@ -1,0 +1,105 @@
+"""Input pipelines: synthetic + memory-mapped token streams.
+
+The reference has no data layer (user scripts bring their own input_fn);
+this module provides the minimum a training job needs in a zero-egress
+environment: a deterministic synthetic LM stream (benchmarks, tests) and a
+memory-mapped binary token file reader (real corpora), both yielding
+pre-shifted (inputs, targets) pairs shaped for the mesh's batch sharding.
+
+Per-process sharding follows the jax.distributed contract: each process
+yields only its slice of the global batch
+(process_index/process_count), and jax.make_array_from_process_local_data
+assembles the global array.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+Batch = tuple[jax.Array, jax.Array]  # (inputs [B,S], targets [B,S])
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    global_batch: int = 8
+    seq_len: int = 2048
+    vocab_size: int = 32000
+    seed: int = 0
+    path: str = ""  # empty -> synthetic
+
+
+def _local_slice(global_batch: int) -> tuple[int, int]:
+    n, i = jax.process_count(), jax.process_index()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} processes")
+    per = global_batch // n
+    return per, i * per
+
+
+def synthetic_batches(
+    cfg: DataConfig, sharding: NamedSharding | None = None, start_step: int = 0
+) -> Iterator[Batch]:
+    """Endless deterministic token stream (Zipf-ish marginals so the loss
+    moves like text, not uniform noise). ``start_step`` keys the generator
+    per batch, so a checkpoint-resumed job continues the stream instead of
+    replaying it."""
+    per, _ = _local_slice(cfg.global_batch)
+    ranks = np.arange(1, cfg.vocab_size + 1, dtype=np.float64)
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    step = start_step
+    while True:
+        rng = np.random.default_rng((cfg.seed + jax.process_index(), step))
+        tokens = rng.choice(cfg.vocab_size, size=(per, cfg.seq_len + 1), p=probs)
+        step += 1
+        yield _to_global(tokens.astype(np.int32), sharding)
+
+
+def mmap_batches(
+    cfg: DataConfig, sharding: NamedSharding | None = None, start_step: int = 0
+) -> Iterator[Batch]:
+    """Sequential reader over a flat binary int32 token file (np.memmap).
+
+    Each process strides disjoint windows; wraps around at EOF. ``start_step``
+    resumes the stream at the position step N would have read (elastic
+    restart: no token is replayed or skipped).
+    """
+    data = np.memmap(cfg.path, dtype=np.int32, mode="r")
+    per, off = _local_slice(cfg.global_batch)
+    window = cfg.seq_len + 1
+    stride = cfg.global_batch * window
+    n = len(data)
+    if n < stride:
+        raise ValueError(f"token file too small: {n} tokens < one global batch {stride}")
+    steps_per_epoch = n // stride  # windows before wrap-around
+    step = start_step
+    while True:
+        pos = (step % steps_per_epoch) * stride + off * window
+        chunk = np.asarray(data[pos : pos + per * window]).reshape(per, window)
+        step += 1
+        yield _to_global(chunk, sharding)
+
+
+def _to_global(tokens: np.ndarray, sharding: NamedSharding | None) -> Batch:
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if sharding is None:
+        return jnp.asarray(inputs), jnp.asarray(targets)
+    return (
+        jax.make_array_from_process_local_data(sharding, inputs),
+        jax.make_array_from_process_local_data(sharding, targets),
+    )
+
+
+def make_batches(
+    cfg: DataConfig, sharding: NamedSharding | None = None, start_step: int = 0
+) -> Iterator[Batch]:
+    fn = mmap_batches if cfg.path else synthetic_batches
+    return fn(cfg, sharding, start_step)
+
+
+__all__ = ["Batch", "DataConfig", "make_batches", "mmap_batches", "synthetic_batches"]
