@@ -84,7 +84,9 @@ def test_model_level_ring_attention_via_default_mesh():
     """LlamaConfig(attention_impl='ring') end to end on an sp mesh."""
     from tony_tpu.models.llama import LlamaConfig, forward, init_params
 
-    mesh = build_mesh(MeshShape(sp=8))  # registers the default mesh
+    from tony_tpu.parallel.mesh import set_default_mesh
+
+    set_default_mesh(build_mesh(MeshShape(sp=8)))
     cfg_ring = LlamaConfig.tiny(attention_impl="ring")
     cfg_dot = LlamaConfig.tiny(attention_impl="dot")
     params = init_params(jax.random.key(0), cfg_dot)

@@ -48,6 +48,11 @@ class ApplicationMaster(ApplicationRpcServicer):
         if not self.specs:
             raise ValueError("no job types configured (need job.<type>.instances)")
         chief = "chief" if "chief" in self.specs else ""
+        # AM-side pre-schedule validation hook (reference: Framework.AMAdapter
+        # validateConfig), e.g. mxnet requiring exactly one scheduler.
+        from tony_tpu.runtime import make_runtime
+
+        make_runtime(config.get_str(Keys.APPLICATION_FRAMEWORK, "jax")).validate(config)
         self.session = Session(self.specs, chief_type=chief)
         self.backend = make_backend(config.get_str(Keys.CLUSTER_BACKEND, "local"))
         self.events = EventWriter(
@@ -191,7 +196,13 @@ class ApplicationMaster(ApplicationRpcServicer):
 
     def PushMetrics(self, request, context):  # noqa: N802
         tid = f"{request.job_name}:{request.index}"
-        self._latest_metrics[tid] = {s.name: s.value for s in request.samples}
+        samples = {s.name: s.value for s in request.samples}
+        self._latest_metrics[tid] = samples
+        # feed the history pipeline so the portal can chart them (the
+        # reference embeds utilization in its avro events the same way).
+        # samples nest under their own key: names are user-chosen and must
+        # not collide with the event envelope (type/ts/app_id/task).
+        self.events.emit(EventType.METRICS, task=tid, samples=samples)
         return pb.Empty()
 
     # --- RPC handlers (client-facing) ----------------------------------------
@@ -250,8 +261,17 @@ class ApplicationMaster(ApplicationRpcServicer):
     def run(self) -> int:
         """Run the job to completion; returns the client exit code."""
         os.makedirs(os.path.join(self.app_dir, "logs"), exist_ok=True)
+        token = None
+        if self.config.get_bool(Keys.APPLICATION_SECURITY_ENABLED, False):
+            from tony_tpu.rpc.auth import read_token
+
+            token = read_token(self.app_dir)
+            if not token:
+                raise RuntimeError(
+                    "application.security.enabled but no app.token staged"
+                )
         self._server, self.port = serve(
-            self, port=self.config.get_int(Keys.AM_RPC_PORT, 0)
+            self, port=self.config.get_int(Keys.AM_RPC_PORT, 0), token=token
         )
         # The client discovers the AM address from this file (the YARN
         # application-report analogue).

@@ -64,13 +64,20 @@ class TonyClient:
     # --- submission ----------------------------------------------------------
 
     def stage(self) -> None:
-        """Materialise the application dir: config.json + src/ copy."""
+        """Materialise the application dir: config.json + src/ copy + token."""
         os.makedirs(self.app_dir, exist_ok=True)
         with open(os.path.join(self.app_dir, "config.json"), "w") as f:
             f.write(self.config.to_json())
         if self.src_dir:
             dst = os.path.join(self.app_dir, "src")
             shutil.copytree(self.src_dir, dst, dirs_exist_ok=True)
+        self._token = None
+        if self.config.get_bool(Keys.APPLICATION_SECURITY_ENABLED, False):
+            from tony_tpu.rpc.auth import mint_token
+
+            # The delegation-token analogue: minted at staging, file-scoped
+            # (0600), required on every control-plane RPC.
+            self._token = mint_token(self.app_dir)
 
     def launch_am(self) -> None:
         am_log = open(os.path.join(self.app_dir, "am.log"), "ab")
@@ -110,7 +117,7 @@ class TonyClient:
     def monitor(self, poll_interval_s: float = 1.0, quiet: bool = False) -> int:
         """Poll status until terminal; mirrors the reference client's report loop."""
         addr = self.am_address()
-        client = ApplicationRpcClient(addr)
+        client = ApplicationRpcClient(addr, token=getattr(self, "_token", None))
         last_states: dict[str, str] = {}
         printed_tb = False
         try:

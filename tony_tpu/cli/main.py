@@ -24,6 +24,7 @@ import grpc
 from tony_tpu.cli.client import TonyClient, default_apps_root, resolve_app_dir
 from tony_tpu.config.config import TonyConfig
 from tony_tpu.rpc import ApplicationRpcClient
+from tony_tpu.rpc.auth import read_token
 
 
 def _read_am_addr(app_dir: str) -> str | None:
@@ -50,7 +51,7 @@ def _status_dict(app_dir: str) -> dict:
     addr = _read_am_addr(app_dir)
     if addr:
         try:
-            with ApplicationRpcClient(addr, timeout_s=3.0) as c:
+            with ApplicationRpcClient(addr, timeout_s=3.0, token=read_token(app_dir)) as c:
                 s = c.get_application_status()
                 return {
                     "state": s.state,
@@ -113,7 +114,7 @@ def cmd_stop(args: argparse.Namespace) -> int:
         print("AM address unknown; application may not be running", file=sys.stderr)
         return 1
     try:
-        with ApplicationRpcClient(addr, timeout_s=5.0) as c:
+        with ApplicationRpcClient(addr, timeout_s=5.0, token=read_token(app_dir)) as c:
             c.stop_application(args.reason)
         print("stop requested")
         return 0

@@ -49,7 +49,12 @@ class TaskExecutor:
         self.runtime = make_runtime(
             self.config.get_str(Keys.APPLICATION_FRAMEWORK, "jax")
         )
-        self.client = ApplicationRpcClient(self.am_addr)
+        token = None
+        if self.config.get_bool(Keys.APPLICATION_SECURITY_ENABLED, False):
+            from tony_tpu.rpc.auth import read_token
+
+            token = read_token(os.environ.get("TONY_APP_DIR", ""))
+        self.client = ApplicationRpcClient(self.am_addr, token=token)
         self.host = local_host()
         self.port = find_free_port() if self.runtime.needs_data_port() else 0
         self._abort = threading.Event()

@@ -1,9 +1,10 @@
-"""Observability: step metrics, resource monitor, profiler glue, portal.
+"""Observability: step metrics, resource monitor, profiler, portal, proxy.
 
 Only the stdlib-only TaskMonitor is exported eagerly; metrics.py imports jax
 at module top, so it is deliberately NOT re-exported here — executors for
 non-JAX frameworks import this package from the metrics thread and must not
-pay (or fail on) a jax import.
+pay (or fail on) a jax import. Portal/proxy/profiler/reporter are run or
+imported as submodules.
 """
 
 from tony_tpu.obs.monitor import TaskMonitor

@@ -1,0 +1,218 @@
+"""Job-history portal: the tony-portal analogue.
+
+The reference ships a Play-framework web UI that scans the finished-jobs
+HDFS dir, parses avro .jhist files, and renders jobs / per-job config /
+events / metrics pages (SURVEY.md sections 2 "tony-portal", 3.5). Here the
+same read path is a stdlib ThreadingHTTPServer over the apps root: each
+application dir carries status.json, config.json, events/*.jhist.jsonl and
+logs/ — everything the portal needs, no database.
+
+Endpoints:
+    /                    jobs table (HTML)
+    /job/<app_id>        job detail: status, tasks, config, events (HTML)
+    /job/<app_id>/log/<task>   task log (text)
+    /api/jobs            jobs list (JSON)
+    /api/job/<app_id>    full detail (JSON)
+
+Run:  python -m tony_tpu.obs.portal --port 8080 [--apps-root DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import os
+import re
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tony_tpu.am.events import read_history
+from tony_tpu.cli.client import default_apps_root
+
+_APP_ID_RE = re.compile(r"^[\w.-]+$")  # path-traversal guard
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class PortalData:
+    """Filesystem read layer (kept separate from HTTP for tests)."""
+
+    def __init__(self, apps_root: str):
+        self.apps_root = apps_root
+
+    def jobs(self) -> list[dict]:
+        out = []
+        if not os.path.isdir(self.apps_root):
+            return out
+        for app_id in sorted(os.listdir(self.apps_root), reverse=True):
+            app_dir = os.path.join(self.apps_root, app_id)
+            if not os.path.isdir(app_dir):
+                continue
+            status = _read_json(os.path.join(app_dir, "status.json"))
+            config = _read_json(os.path.join(app_dir, "config.json")) or {}
+            out.append(
+                {
+                    "app_id": app_id,
+                    "state": (status or {}).get("state", "RUNNING?"),
+                    "exit_code": (status or {}).get("exit_code", ""),
+                    "framework": config.get("application.framework", ""),
+                    "name": config.get("application.name", ""),
+                }
+            )
+        return out
+
+    def job(self, app_id: str) -> dict | None:
+        if not _APP_ID_RE.match(app_id):
+            return None
+        app_dir = os.path.join(self.apps_root, app_id)
+        if not os.path.isdir(app_dir):
+            return None
+        events = []
+        ev_dir = os.path.join(app_dir, "events")
+        if os.path.isdir(ev_dir):
+            for name in sorted(os.listdir(ev_dir)):
+                if name.endswith(".jsonl"):
+                    try:
+                        events.extend(read_history(os.path.join(ev_dir, name)))
+                    except (OSError, json.JSONDecodeError):
+                        pass
+        logs = []
+        logs_dir = os.path.join(app_dir, "logs")
+        if os.path.isdir(logs_dir):
+            logs = sorted(os.listdir(logs_dir))
+        return {
+            "app_id": app_id,
+            "status": _read_json(os.path.join(app_dir, "status.json")),
+            "config": _read_json(os.path.join(app_dir, "config.json")),
+            "events": events,
+            "logs": logs,
+        }
+
+    def log(self, app_id: str, name: str) -> str | None:
+        if not _APP_ID_RE.match(app_id) or os.sep in name or name.startswith("."):
+            return None
+        path = os.path.join(self.apps_root, app_id, "logs", name)
+        try:
+            with open(path, errors="replace") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+_PAGE = """<!doctype html><html><head><title>tony-tpu portal</title><style>
+body {{ font-family: monospace; margin: 2em; }} table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #999; padding: 4px 10px; text-align: left; }}
+.SUCCEEDED {{ color: #080 }} .FAILED {{ color: #b00 }} .KILLED {{ color: #b60 }}
+pre {{ background: #f4f4f4; padding: 1em; overflow-x: auto; }}
+</style></head><body>{body}</body></html>"""
+
+
+def _jobs_html(jobs: list[dict]) -> str:
+    rows = "".join(
+        f"<tr><td><a href='/job/{html.escape(j['app_id'])}'>{html.escape(j['app_id'])}</a></td>"
+        f"<td class='{html.escape(str(j['state']))}'>{html.escape(str(j['state']))}</td>"
+        f"<td>{html.escape(str(j['exit_code']))}</td>"
+        f"<td>{html.escape(str(j['framework']))}</td></tr>"
+        for j in jobs
+    )
+    return _PAGE.format(
+        body=f"<h1>tony-tpu jobs</h1><table><tr><th>application</th><th>state</th>"
+        f"<th>exit</th><th>framework</th></tr>{rows}</table>"
+    )
+
+
+def _job_html(detail: dict) -> str:
+    app_id = html.escape(detail["app_id"])
+    status = detail["status"] or {}
+    tasks = "".join(
+        f"<tr><td>{html.escape(t['task'])}</td><td class='{html.escape(t['state'])}'>"
+        f"{html.escape(t['state'])}</td><td>{t.get('exit_code')}</td>"
+        f"<td>{t.get('attempts')}</td></tr>"
+        for t in status.get("tasks", [])
+    )
+    logs = "".join(
+        f"<li><a href='/job/{app_id}/log/{html.escape(n)}'>{html.escape(n)}</a></li>"
+        for n in detail["logs"]
+    )
+    events = html.escape(
+        "\n".join(json.dumps(e, sort_keys=True) for e in detail["events"])
+    )
+    config = html.escape(json.dumps(detail["config"] or {}, indent=1, sort_keys=True))
+    return _PAGE.format(
+        body=f"<h1>{app_id}</h1>"
+        f"<p>state: <b class='{html.escape(str(status.get('state')))}'>"
+        f"{html.escape(str(status.get('state', 'RUNNING?')))}</b>"
+        f" exit={status.get('exit_code')}</p>"
+        f"<h2>tasks</h2><table><tr><th>task</th><th>state</th><th>exit</th>"
+        f"<th>attempts</th></tr>{tasks}</table>"
+        f"<h2>logs</h2><ul>{logs}</ul>"
+        f"<h2>events</h2><pre>{events}</pre>"
+        f"<h2>config</h2><pre>{config}</pre>"
+        f"<p><a href='/'>&larr; all jobs</a></p>"
+    )
+
+
+def make_handler(data: PortalData):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, code: int, body: str, ctype: str = "text/html") -> None:
+            raw = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", f"{ctype}; charset=utf-8")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            parts = [p for p in self.path.split("/") if p]
+            if not parts:
+                return self._send(200, _jobs_html(data.jobs()))
+            if parts[0] == "api":
+                if len(parts) == 2 and parts[1] == "jobs":
+                    return self._send(200, json.dumps(data.jobs()), "application/json")
+                if len(parts) == 3 and parts[1] == "job":
+                    detail = data.job(parts[2])
+                    if detail is not None:
+                        return self._send(200, json.dumps(detail), "application/json")
+                return self._send(404, "{}", "application/json")
+            if parts[0] == "job" and len(parts) >= 2:
+                detail = data.job(parts[1])
+                if detail is None:
+                    return self._send(404, _PAGE.format(body="<h1>not found</h1>"))
+                if len(parts) == 4 and parts[2] == "log":
+                    text = data.log(parts[1], parts[3])
+                    if text is None:
+                        return self._send(404, "not found", "text/plain")
+                    return self._send(200, text, "text/plain")
+                return self._send(200, _job_html(detail))
+            return self._send(404, _PAGE.format(body="<h1>not found</h1>"))
+
+    return Handler
+
+
+def serve_portal(apps_root: str, port: int = 0, host: str = "0.0.0.0"):
+    """Start the portal; returns (server, bound_port). server.serve_forever()."""
+    server = ThreadingHTTPServer((host, port), make_handler(PortalData(apps_root)))
+    return server, server.server_address[1]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="tony-tpu job-history portal")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--apps-root", default=default_apps_root())
+    args = p.parse_args()
+    server, port = serve_portal(args.apps_root, args.port)
+    print(f"portal serving {args.apps_root} on :{port}")
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Profiler glue: jax.profiler wired to a flag.
+
+The reference has no profiler integration (SURVEY.md section 5 "Tracing":
+logs + metrics sampler only); on TPU this is the highest-leverage
+observability upgrade, kept deliberately thin: one flag
+(``profiler.enabled``) starts the trace server inside the training process,
+and ``trace_window`` dumps a perfetto-readable trace of N steps.
+
+    with trace_window("/tmp/trace", enabled=step == 10):
+        state, metrics = step_fn(state, ...)
+        jax.block_until_ready(metrics)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def start_server(port: int = 9999) -> bool:
+    """Start the profiler's TCP server (for `tensorboard --logdir` capture
+    or `jax.profiler.trace` remote attach). Returns False if unavailable."""
+    try:
+        jax.profiler.start_server(port)
+        log.info("jax profiler server on :%d", port)
+        return True
+    except Exception:
+        log.warning("could not start profiler server", exc_info=True)
+        return False
+
+
+@contextlib.contextmanager
+def trace_window(log_dir: str, enabled: bool = True):
+    """Trace everything inside the block into ``log_dir`` (perfetto/XPlane).
+
+    The caller must block_until_ready inside the window for device activity
+    to be attributed (dispatch is async)."""
+    if not enabled:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+    log.info("profiler trace written to %s", log_dir)
+
+
+def annotate(name: str):
+    """Named region in traces: ``with annotate('data-load'): ...``"""
+    return jax.profiler.TraceAnnotation(name)
+
+
+__all__ = ["annotate", "start_server", "trace_window"]

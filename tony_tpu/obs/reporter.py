@@ -1,0 +1,71 @@
+"""Training-metrics reporter: user process -> AM metrics RPC.
+
+Closes the loop the reference draws as TaskMonitor -> MetricsRpc -> AM ->
+history events -> portal (SURVEY.md section 5 "Metrics"): beyond the
+executor's generic cpu/rss sampler, the *training* process can push
+step-level throughput/loss/MFU — the numbers that actually matter on TPU —
+through the same channel. fit() wires this automatically when running under
+a tony-tpu job (the TONY_AM_ADDR env is present).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+log = logging.getLogger(__name__)
+
+
+class MetricsReporter:
+    """Best-effort pusher; never lets metrics failures hurt training."""
+
+    def __init__(self) -> None:
+        self._client = None
+        self.job_name = os.environ.get("TONY_JOB_NAME", "")
+        self.index = int(os.environ.get("TONY_TASK_INDEX", "0"))
+        addr = os.environ.get("TONY_AM_ADDR", "")
+        if not addr:
+            return
+        try:
+            from tony_tpu.rpc import ApplicationRpcClient
+            from tony_tpu.rpc.auth import read_token
+
+            token = read_token(os.environ.get("TONY_APP_DIR", ""))
+            self._client = ApplicationRpcClient(addr, timeout_s=3.0, token=token)
+        except Exception:
+            log.debug("metrics reporter disabled", exc_info=True)
+
+    @property
+    def active(self) -> bool:
+        return self._client is not None
+
+    def push(self, metrics: dict) -> None:
+        if self._client is None:
+            return
+        now = time.time()
+        samples = [
+            (k, float(v), now)
+            for k, v in metrics.items()
+            if isinstance(v, (int, float))
+        ]
+        try:
+            self._client.push_metrics(self.job_name, self.index, samples)
+        except Exception:
+            pass  # AM busy/tearing down; training goes on
+
+    def register_tensorboard(self, url: str) -> None:
+        if self._client is None:
+            return
+        try:
+            self._client.register_tensorboard_url(url)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+__all__ = ["MetricsReporter"]

@@ -97,10 +97,18 @@ def build_mesh(shape: MeshShape | None = None, devices: list | None = None) -> M
             f"got {len(devices)}"
         )
     if shape.n_devices < len(devices):
-        # Legitimate for tests (sub-meshes of the virtual CPU set) but almost
-        # certainly a stale config in production — say so loudly.
+        # Truncation is only safe single-process (sub-meshes of one host's
+        # devices, mostly tests): multi-host, the first-N global devices can
+        # exclude every device of some process, which then fails far from the
+        # config mistake. Loud warning either way — idle chips are a bug.
         import logging
 
+        if jax.process_count() > 1:
+            raise ValueError(
+                f"mesh shape {shape.sizes} uses {shape.n_devices} of "
+                f"{len(devices)} devices; undersized meshes are not allowed "
+                "multi-host (some processes would own no mesh device)"
+            )
         logging.getLogger(__name__).warning(
             "mesh shape %s uses only %d of %d devices; %d idle",
             shape.sizes, shape.n_devices, len(devices), len(devices) - shape.n_devices,
@@ -111,11 +119,7 @@ def build_mesh(shape: MeshShape | None = None, devices: list | None = None) -> M
     except (ValueError, AssertionError):
         # Virtual/CPU device sets lack topology metadata; fall back to raveled order.
         dev_array = np.asarray(devices).reshape(shape.sizes)
-    mesh = Mesh(dev_array, MESH_AXES)
-    # Register as the default mesh for model-level hooks (e.g.
-    # LlamaConfig(attention_impl='ring'/'flash') resolves its mesh here).
-    set_default_mesh(mesh)
-    return mesh
+    return Mesh(dev_array, MESH_AXES)
 
 
 def single_device_mesh() -> Mesh:

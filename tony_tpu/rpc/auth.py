@@ -1,0 +1,80 @@
+"""Control-plane auth: per-application shared token.
+
+The reference's security layer is Hadoop-native — Kerberos keytab login and
+HDFS/RM delegation tokens propagated into container credentials, gated by
+``tony.application.security.enabled`` (SURVEY.md section 2 "Security").
+There is no Kerberos here; the equivalent trust model is a per-application
+random token, minted by the client at staging time, passed to containers via
+a file (never argv), and required on every control-plane RPC through gRPC
+metadata. Gated by ``application.security.enabled`` just like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+
+import grpc
+
+TOKEN_FILE = "app.token"
+_HEADER = "tony-auth-token"
+
+
+def mint_token(app_dir: str) -> str:
+    """Create the application token file (client-side, at staging)."""
+    token = secrets.token_hex(32)
+    path = os.path.join(app_dir, TOKEN_FILE)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(token)
+    return token
+
+
+def read_token(app_dir: str) -> str | None:
+    try:
+        with open(os.path.join(app_dir, TOKEN_FILE)) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+class TokenServerInterceptor(grpc.ServerInterceptor):
+    """Rejects any call without the right token (UNAUTHENTICATED)."""
+
+    def __init__(self, token: str):
+        self._token = token
+
+        def deny(request, context):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, "bad or missing token")
+
+        self._deny = grpc.unary_unary_rpc_method_handler(deny)
+
+    def intercept_service(self, continuation, handler_call_details):
+        meta = dict(handler_call_details.invocation_metadata or ())
+        if meta.get(_HEADER) == self._token:
+            return continuation(handler_call_details)
+        return self._deny
+
+
+class TokenCallCredentials(grpc.AuthMetadataPlugin):
+    """Client-side: attach the token to every call."""
+
+    def __init__(self, token: str):
+        self._token = token
+
+    def __call__(self, context, callback):
+        callback(((_HEADER, self._token),), None)
+
+
+def client_metadata(token: str) -> list[tuple[str, str]]:
+    return [(_HEADER, token)]
+
+
+__all__ = [
+    "TOKEN_FILE",
+    "TokenCallCredentials",
+    "TokenServerInterceptor",
+    "client_metadata",
+    "mint_token",
+    "read_token",
+]

@@ -92,8 +92,13 @@ def serve(
     host: str = "0.0.0.0",
     port: int = 0,
     max_workers: int = 16,
+    token: str | None = None,
 ) -> tuple[grpc.Server, int]:
-    """Start the RPC server; returns (server, bound_port)."""
+    """Start the RPC server; returns (server, bound_port).
+
+    ``token`` enables per-application auth (application.security.enabled):
+    every call must carry it in metadata (see rpc.auth).
+    """
     handlers = {
         name: grpc.unary_unary_rpc_method_handler(
             _wrap(getattr(servicer, name)),
@@ -102,7 +107,14 @@ def serve(
         )
         for name, (req, resp) in _METHODS.items()
     }
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    interceptors = ()
+    if token:
+        from tony_tpu.rpc.auth import TokenServerInterceptor
+
+        interceptors = (TokenServerInterceptor(token),)
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers), interceptors=interceptors
+    )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
     )
@@ -121,9 +133,14 @@ class ApplicationRpcClient:
     ApplicationRpcClient and YARN report polling; here the AM answers both.
     """
 
-    def __init__(self, address: str, timeout_s: float = 10.0):
+    def __init__(self, address: str, timeout_s: float = 10.0, token: str | None = None):
         self.address = address
         self.timeout_s = timeout_s
+        self._metadata = None
+        if token:
+            from tony_tpu.rpc.auth import client_metadata
+
+            self._metadata = client_metadata(token)
         self._channel = grpc.insecure_channel(
             address,
             options=[
@@ -141,7 +158,9 @@ class ApplicationRpcClient:
 
     def _call(self, name: str, request, timeout_s: float | None = None):
         stub = getattr(self, f"_stub_{name}")
-        return stub(request, timeout=timeout_s or self.timeout_s)
+        return stub(
+            request, timeout=timeout_s or self.timeout_s, metadata=self._metadata
+        )
 
     # --- executor-side ---
     def register_worker_spec(

@@ -4,6 +4,7 @@ from tony_tpu.runtime.base import Runtime, TaskIdentity
 from tony_tpu.runtime.frameworks import (
     HorovodRuntime,
     MLGenericRuntime,
+    MXNetRuntime,
     PyTorchRuntime,
     TFRuntime,
 )
@@ -11,7 +12,7 @@ from tony_tpu.runtime.jax_tpu import JaxTpuRuntime, in_tony_job, initialize
 
 _RUNTIMES = {
     cls.name: cls
-    for cls in (JaxTpuRuntime, TFRuntime, PyTorchRuntime, HorovodRuntime, MLGenericRuntime)
+    for cls in (JaxTpuRuntime, TFRuntime, PyTorchRuntime, HorovodRuntime, MXNetRuntime, MLGenericRuntime)
 }
 
 
@@ -29,6 +30,7 @@ __all__ = [
     "HorovodRuntime",
     "JaxTpuRuntime",
     "MLGenericRuntime",
+    "MXNetRuntime",
     "PyTorchRuntime",
     "Runtime",
     "TFRuntime",

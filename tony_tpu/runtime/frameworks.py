@@ -101,6 +101,43 @@ class HorovodRuntime(Runtime):
         return env
 
 
+class MXNetRuntime(Runtime):
+    """MXNet parameter-server (DMLC/kvstore) env contract.
+
+    Reference parity for the MXNetRuntime adapter (SURVEY.md section 2
+    "Runtime adapters"): DMLC processes find each other through the
+    scheduler's address. Job types map directly: ``scheduler`` (1 instance),
+    ``server``, ``worker``; the scheduler task doubles as the root URI.
+    """
+
+    name = "mxnet"
+
+    def validate(self, config: TonyConfig) -> None:
+        if "scheduler" not in config.job_types():
+            raise ValueError("mxnet jobs need a [job.scheduler] with instances = 1")
+        if config.task_spec("scheduler").instances != 1:
+            raise ValueError("mxnet jobs need exactly one scheduler instance")
+
+    def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
+        env = super().build_env(identity, config)
+        schedulers = identity.cluster_spec.get("scheduler", [])
+        if len(schedulers) != 1:
+            raise ValueError(
+                f"mxnet cluster spec needs exactly one scheduler, got {schedulers}"
+            )
+        host, _, port = schedulers[0].rpartition(":")
+        env.update(
+            {
+                "DMLC_ROLE": identity.job_name,
+                "DMLC_PS_ROOT_URI": host,
+                "DMLC_PS_ROOT_PORT": port,
+                "DMLC_NUM_SERVER": str(len(identity.cluster_spec.get("server", []))),
+                "DMLC_NUM_WORKER": str(len(identity.cluster_spec.get("worker", []))),
+            }
+        )
+        return env
+
+
 class MLGenericRuntime(Runtime):
     """No framework assumptions: just the TONY_* cluster env (base class)."""
 
@@ -110,4 +147,10 @@ class MLGenericRuntime(Runtime):
         return True
 
 
-__all__ = ["HorovodRuntime", "MLGenericRuntime", "PyTorchRuntime", "TFRuntime"]
+__all__ = [
+    "HorovodRuntime",
+    "MLGenericRuntime",
+    "MXNetRuntime",
+    "PyTorchRuntime",
+    "TFRuntime",
+]

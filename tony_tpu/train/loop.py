@@ -51,7 +51,20 @@ class FitConfig:
 def fit(cfg: FitConfig) -> dict:
     """Run the training loop to cfg.steps; returns final metrics."""
     jax_tpu.initialize()  # no-op outside a tony-tpu job
+    reporter = None
+    on_metrics = cfg.on_metrics
+    if on_metrics is None and jax_tpu.in_tony_job():
+        # push step metrics to the AM (TaskMonitor/MetricsRpc pipeline)
+        from tony_tpu.obs.reporter import MetricsReporter
+
+        reporter = MetricsReporter()
+        if reporter.active:
+            on_metrics = reporter.push
     mesh = build_mesh(cfg.mesh_shape)
+    # model-level attention hooks ('ring'/'flash') resolve this mesh
+    from tony_tpu.parallel.mesh import set_default_mesh
+
+    set_default_mesh(mesh)
     if jax.process_index() == 0:
         log.info("mesh: %s over %d devices", dict(mesh.shape), mesh.size)
 
@@ -109,8 +122,8 @@ def fit(cfg: FitConfig) -> dict:
                     "step %(step)d loss=%(loss)s %(tokens_per_sec_per_chip)s tok/s/chip "
                     "mfu=%(mfu)s", out,
                 )
-            if cfg.on_metrics:
-                cfg.on_metrics(out)
+            if on_metrics:
+                on_metrics(out)
             t_window = time.perf_counter()
             window = 0
         if manager is not None and manager.should_save(step + 1):
@@ -120,6 +133,8 @@ def fit(cfg: FitConfig) -> dict:
         if manager.latest_step() != cfg.steps:
             manager.save(cfg.steps, state, force=True)
         manager.close()
+    if reporter is not None:
+        reporter.close()
     final = {"final_loss": float(metrics.get("loss", float("nan"))), "steps": cfg.steps}
     return final
 

@@ -1,0 +1,138 @@
+"""ctypes binding for the native token loader (native/tonyloader.cpp).
+
+The C++ loader prefetches shuffled (seq_len+1)-token windows from a
+memory-mapped corpus on a real thread, off the GIL — the trainer's host step
+overlaps with input IO. Built on demand with g++ (pybind11 is not in the
+image; the C ABI + ctypes needs no build-time Python headers).
+
+Falls back cleanly: ``available()`` is False when no compiler/binary exists,
+and train/data.py keeps its pure-numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Iterator
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "tonyloader.cpp")
+_LIB_NAME = "libtonyloader.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def _build_dir() -> str:
+    d = os.environ.get("TONY_NATIVE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "tony-tpu"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        lib_path = os.path.join(_build_dir(), _LIB_NAME)
+        src = os.path.abspath(_SRC)
+        if not os.path.exists(src):
+            return None
+        if (not os.path.exists(lib_path)
+                or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                     src, "-o", lib_path],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError) as e:
+                log.warning("native loader build failed: %s", e)
+                return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError as e:
+            log.warning("native loader load failed: %s", e)
+            return None
+        lib.tl_open.restype = ctypes.c_void_p
+        lib.tl_open.argtypes = [ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+                                ctypes.c_long, ctypes.c_long, ctypes.c_ulonglong]
+        lib.tl_next.restype = ctypes.c_long
+        lib.tl_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+        lib.tl_windows_per_epoch.restype = ctypes.c_long
+        lib.tl_windows_per_epoch.argtypes = [ctypes.c_void_p]
+        lib.tl_seek.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.tl_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeTokenLoader:
+    """Shuffled, prefetched batches from a flat int32 token file.
+
+    Yields [batch, seq_len+1] int32 arrays; each epoch covers every window
+    of this shard exactly once in a seeded order. ``seek(step)`` gives
+    resume-exact positioning for elastic restart.
+    """
+
+    def __init__(self, path: str, seq_len: int, batch: int,
+                 n_shards: int = 1, shard_id: int = 0, seed: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native loader unavailable (no g++ or build failed)")
+        self._lib = lib
+        self._handle = lib.tl_open(
+            path.encode(), seq_len, batch, n_shards, shard_id, seed
+        )
+        if not self._handle:
+            raise ValueError(
+                f"tl_open failed for {path!r} (missing file or too few windows "
+                f"for batch={batch} x shards={n_shards})"
+            )
+        self.batch = batch
+        self.window = seq_len + 1
+        self._buf = np.empty((batch, self.window), np.int32)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self._lib.tl_windows_per_epoch(self._handle)
+
+    def seek(self, step: int) -> None:
+        self._lib.tl_seek(self._handle, step)
+
+    def next(self) -> np.ndarray:
+        rc = self._lib.tl_next(
+            self._handle, self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        )
+        if rc != 0:
+            raise RuntimeError("native loader stopped")
+        return self._buf.copy()
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next()
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tl_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "NativeTokenLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+__all__ = ["NativeTokenLoader", "available"]
