@@ -26,8 +26,8 @@ def run_bench() -> dict:
 
     on_tpu = jax.devices()[0].platform != "cpu"
     if on_tpu:
-        cfg = LlamaConfig.bench_410m(attention_impl="flash")
-        batch, seq, steps = 8, 2048, 10
+        cfg = LlamaConfig.bench_1b4(attention_impl="flash")
+        batch, seq, steps = 4, 2048, 10
     else:  # CPU fallback so the driver always gets a line
         cfg = LlamaConfig.tiny()
         batch, seq, steps = 4, 64, 3
@@ -59,7 +59,7 @@ def run_bench() -> dict:
     peak = chip_peak_flops()
     mfu = timer.mfu(peak)
     return {
-        "metric": "llama410m_train_tokens_per_sec_per_chip"
+        "metric": "llama1.4b_train_tokens_per_sec_per_chip"
         if on_tpu
         else "llama_tiny_cpu_tokens_per_sec",
         "value": round(timer.tokens_per_sec_per_chip, 1),

@@ -31,9 +31,9 @@ import pytest
 
 @pytest.fixture(autouse=True)
 def _reset_default_mesh():
-    """The default mesh is process-global (build_mesh registers it); reset
-    between tests so a mesh from one test can't leak into another's model
-    hooks (attention_impl='flash'/'ring')."""
+    """The default mesh is process-global (fit()/tests register it explicitly);
+    reset between tests so a mesh from one test can't leak into another's
+    model hooks (attention_impl='flash'/'ring')."""
     yield
     from tony_tpu.parallel.mesh import set_default_mesh
 

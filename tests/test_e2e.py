@@ -240,3 +240,82 @@ def test_jax_distributed_two_process_reduction(tmp_path):
         for n in sorted(os.listdir(logs_dir)):
             print(f"===== {n}", open(os.path.join(logs_dir, n), errors="replace").read()[-2000:])
     assert code == 0
+
+
+def test_heartbeat_loss_detected(tmp_path):
+    """User script SIGSTOPs its executor: heartbeats stop while the
+    container stays alive — the AM's missed-heartbeat accounting must mark
+    the task LOST and fail the job (the tony.task.max-missed-heartbeats
+    path, SURVEY.md section 3.3 heartbeat variant)."""
+    script = (
+        'python -c "import os, signal, time; '
+        "os.kill(int(os.environ['TONY_EXECUTOR_PID']), signal.SIGSTOP); "
+        'time.sleep(600)"'
+    )
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "hbloss",
+            "application.framework": "generic",
+            "task.heartbeat_interval_ms": 100,
+            "task.max_missed_heartbeats": 5,
+            "job.worker.instances": 1,
+            "job.worker.command": script,
+        },
+    )
+    assert code != 0
+    status = read_status(app_dir)
+    assert status["state"] == "FAILED"
+    assert status["tasks"][0]["state"] == "LOST"
+
+
+def test_cli_stop_kills_job(tmp_path):
+    """tony stop: detached submit, stop via RPC, KILLED final state."""
+    import time as _time
+
+    from tony_tpu.cli.main import main as cli_main
+
+    env_root = str(tmp_path)
+    conf = tmp_path / "job.toml"
+    conf.write_text(
+        '[application]\nname = "stopme"\nframework = "generic"\n'
+        f'stage_dir = "{env_root}"\ntimeout_s = 120\n'
+        '[job.worker]\ninstances = 1\n'
+        'command = "python -c \\"import time; time.sleep(300)\\""\n'
+    )
+    rc = cli_main(["submit", "--conf", str(conf), "--detach"])
+    assert rc == 0
+    apps = [d for d in os.listdir(env_root) if d.startswith("stopme")]
+    assert len(apps) == 1
+    app_dir = os.path.join(env_root, apps[0])
+    # wait for the worker to start, then stop
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline:
+        if os.path.exists(os.path.join(app_dir, "am.addr")):
+            break
+        _time.sleep(0.2)
+    assert cli_main(["stop", app_dir]) == 0
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline:
+        if os.path.exists(os.path.join(app_dir, "status.json")):
+            break
+        _time.sleep(0.3)
+    status = read_status(app_dir)
+    assert status["state"] == "KILLED"
+    assert status["exit_code"] == 143
+
+
+def test_application_timeout(tmp_path):
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "timeout",
+            "application.framework": "generic",
+            "application.timeout_s": 3,
+            "job.worker.instances": 1,
+            "job.worker.command": 'python -c "import time; time.sleep(300)"',
+        },
+    )
+    assert code != 0
+    status = read_status(app_dir)
+    assert status["state"] == "FAILED"

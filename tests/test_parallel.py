@@ -196,3 +196,22 @@ class TestMoE:
         x_s = jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"), None, None)))
         got, _ = jax.jit(lambda p, a: moe_block(p, a, cfg))(params_s, x_s)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expect), atol=1e-4)
+
+
+def test_multislice_mesh_shape_and_training():
+    """Hybrid ICI x DCN mesh (CPU fallback layout): dp crosses 'slices'."""
+    from tony_tpu.parallel import build_multislice_mesh
+
+    mesh = build_multislice_mesh(MeshShape(fsdp=2, tp=2), n_slices=2)
+    assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+
+    from tony_tpu.models.llama import LlamaConfig
+    from tony_tpu.train.trainer import default_optimizer, make_train_state, make_train_step
+
+    cfg = LlamaConfig.tiny()
+    opt = default_optimizer(warmup_steps=1, decay_steps=10)
+    state = make_train_state(jax.random.key(0), cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    tokens = jax.random.randint(jax.random.key(1), (8, 33), 0, cfg.vocab_size)
+    state, metrics = step(state, tokens[:, :-1], tokens[:, 1:])
+    assert jnp.isfinite(float(metrics["loss"]))

@@ -76,6 +76,8 @@ class ApplicationMaster(ApplicationRpcServicer):
         self._restart_policy = config.get_str(Keys.RESTART_POLICY, "never")
         self._max_restarts = config.get_int(Keys.RESTART_MAX_WORKER_RESTARTS, 0)
         self._latest_metrics: dict[str, dict[str, float]] = {}
+        self._last_metrics_event: dict[str, float] = {}
+        self._metrics_event_min_interval_s = 30.0
         self._scheduler_mode = config.get_str(Keys.SCHEDULER_MODE, "GANG").upper()
 
     # --- executor launch ----------------------------------------------------
@@ -200,9 +202,13 @@ class ApplicationMaster(ApplicationRpcServicer):
         self._latest_metrics[tid] = samples
         # feed the history pipeline so the portal can chart them (the
         # reference embeds utilization in its avro events the same way).
-        # samples nest under their own key: names are user-chosen and must
-        # not collide with the event envelope (type/ts/app_id/task).
-        self.events.emit(EventType.METRICS, task=tid, samples=samples)
+        # samples nest under their own key (names are user-chosen and must
+        # not collide with the event envelope), and emission is throttled
+        # per task so long jobs don't grow the history file without bound.
+        now = time.monotonic()
+        if now - self._last_metrics_event.get(tid, 0.0) >= self._metrics_event_min_interval_s:
+            self._last_metrics_event[tid] = now
+            self.events.emit(EventType.METRICS, task=tid, samples=samples)
         return pb.Empty()
 
     # --- RPC handlers (client-facing) ----------------------------------------
@@ -214,9 +220,7 @@ class ApplicationMaster(ApplicationRpcServicer):
         state = self.session.state
         code = 0
         if state in (JobState.SUCCEEDED, JobState.FAILED, JobState.KILLED):
-            _, code = self.session.final_status()
-            if state == JobState.KILLED:
-                code = 143
+            code = self._client_exit_code()
         return pb.GetApplicationStatusResponse(
             state=state.value,
             exit_code=code,
@@ -300,12 +304,19 @@ class ApplicationMaster(ApplicationRpcServicer):
             self.session.diagnostics = f"{type(e).__name__}: {e}"
         finally:
             self._teardown()
+        code = self._client_exit_code()
+        self._write_status(code)
+        return code
+
+    def _client_exit_code(self) -> int:
+        """Exit code for the client, consistent between the status RPC and
+        the final status file: task failures propagate their code; jobs
+        failed for non-task reasons (timeout, scheduler error) report 1."""
         _, code = self.session.final_status()
         if self.session.state == JobState.KILLED:
-            code = 143
-        elif self.session.state == JobState.FAILED and code == 0:
-            code = 1
-        self._write_status(code)
+            return 143
+        if self.session.state == JobState.FAILED and code == 0:
+            return 1
         return code
 
     def _supervise(self, deadline: float | None) -> None:

@@ -149,6 +149,7 @@ class TaskExecutor:
         env = self.runtime.build_env(identity, self.config)
         env["TONY_APP_ID"] = os.environ.get("TONY_APP_ID", "")
         env["TONY_APP_DIR"] = os.environ.get("TONY_APP_DIR", "")
+        env["TONY_EXECUTOR_PID"] = str(os.getpid())
         # This image preloads a TPU PJRT backend into every python process via
         # sitecustomize (gated on PALLAS_AXON_POOL_IPS), which would both
         # seize the chip from non-JAX tasks and pre-initialise backends before

@@ -1,0 +1,14 @@
+"""Model families: Llama decoder transformers + KV-cache generation."""
+
+from tony_tpu.models.generate import KVCache, forward_with_cache, generate
+from tony_tpu.models.llama import LlamaConfig, forward, init_params, loss_fn
+
+__all__ = [
+    "KVCache",
+    "LlamaConfig",
+    "forward",
+    "forward_with_cache",
+    "generate",
+    "init_params",
+    "loss_fn",
+]

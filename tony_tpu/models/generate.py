@@ -1,0 +1,155 @@
+"""KV-cache decoding + generation for the Llama family.
+
+The reference delegates inference entirely (it launches whatever script the
+user brings); here generation is part of the model library. TPU-first
+choices: the cache is a static-shape ring of [L, B, max_len, H_kv, hd]
+buffers updated with dynamic_update_slice (no growing shapes under jit — one
+compile for prefill, one for decode), attention masks by absolute position,
+and the whole decode loop is a single jitted lax.scan with donated cache
+buffers (in-place HBM updates).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tony_tpu.models.llama import LlamaConfig, Params, rms_norm, rope_table, apply_rope
+
+
+class KVCache(NamedTuple):
+    """Per-layer stacked K/V buffers [L, B, max_len, n_kv_heads, head_dim]."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @classmethod
+    def create(cls, cfg: LlamaConfig, batch: int, max_len: int = 0) -> "KVCache":
+        shape = (
+            cfg.n_layers,
+            batch,
+            max_len or cfg.max_seq_len,
+            cfg.n_kv_heads,
+            cfg.head_dim,
+        )
+        return cls(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def _cached_attention(q, k_cache, v_cache, q_pos, cfg: LlamaConfig):
+    """q: [B,S,H,hd]; caches [B,max_len,Hkv,hd]; q_pos: [S] absolute."""
+    rep = cfg.n_heads // cfg.n_kv_heads
+    if rep > 1:
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) * scale
+    k_pos = jnp.arange(k_cache.shape[1])
+    mask = q_pos[:, None] >= k_pos[None, :]  # causal over absolute positions
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+
+
+def forward_with_cache(
+    params: Params,
+    tokens: jax.Array,
+    cache: KVCache,
+    start_pos: jax.Array,
+    cfg: LlamaConfig,
+) -> tuple[jax.Array, KVCache]:
+    """tokens [B,S] starting at absolute position start_pos (traced scalar).
+
+    Returns (logits [B,S,vocab] f32, updated cache). Used for both prefill
+    (S = prompt length) and decode (S = 1) — same trace, two compiles.
+    """
+    B, S = tokens.shape
+    x = params["tok_emb"][tokens]
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    q_pos = start_pos + jnp.arange(S)
+    angles = q_pos.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+
+    def block(x, layer):
+        lp, k_cache, v_cache = layer
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        hd = cfg.head_dim
+        q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, start_pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, start_pos, 0, 0))
+        attn = _cached_attention(q, k_cache, v_cache, q_pos, cfg)
+        x = x + attn.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
+        h2 = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        x = x + (jax.nn.silu(h2 @ lp["w1"]) * (h2 @ lp["w3"])) @ lp["w2"]
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(block, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, KVCache(new_k, new_v)
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: jax.Array | None = None,
+    max_len: int = 0,
+) -> jax.Array:
+    """Autoregressive generation. prompt [B,P] -> [B, P+max_new_tokens].
+
+    temperature 0 = greedy; otherwise softmax sampling, optionally top-k
+    truncated. The decode loop is one jitted lax.scan over steps.
+    """
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    cache = KVCache.create(cfg, B, max_len or max(total, 1))
+    if rng is None:
+        rng = jax.random.key(0)
+
+    prefill = jax.jit(partial(forward_with_cache, cfg=cfg))
+    logits, cache = prefill(params, prompt, cache, jnp.int32(0))
+    next_rng, rng = jax.random.split(rng)
+    last = _sample(logits[:, -1], temperature, top_k, next_rng)
+
+    def step(carry, rng_step):
+        cache, tok, pos = carry
+        logits, cache = forward_with_cache(params, tok[:, None], cache, pos, cfg)
+        nxt = _sample(logits[:, -1], temperature, top_k, rng_step)
+        return (cache, nxt, pos + 1), tok
+
+    # scan emits each step's *input* token, so ys = [last, nxt_1, ...,
+    # nxt_{T-1}] — exactly the max_new_tokens generated tokens in order.
+    steps_rng = jax.random.split(rng, max_new_tokens)
+    _, toks = jax.jit(partial(lax.scan, step))((cache, last, jnp.int32(P)), steps_rng)
+    generated = jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
+    return jnp.concatenate([prompt, generated], axis=1)
+
+
+def _sample(logits: jax.Array, temperature: float, top_k: int, rng: jax.Array) -> jax.Array:
+    """logits [B,V] -> token ids [B]."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+__all__ = ["KVCache", "forward_with_cache", "generate"]

@@ -23,6 +23,7 @@
 //   void  tl_close(void* h)
 //
 // Build: g++ -O3 -std=c++17 -shared -fPIC -pthread tonyloader.cpp -o libtonyloader.so
+// (tony_tpu/train/native_loader.py does this on demand)
 
 #include <array>
 #include <atomic>
@@ -160,7 +161,7 @@ void* tl_open(const char* path, long seq_len, long batch, long n_shards,
   void* mem = mmap(nullptr, L->file_bytes, PROT_READ, MAP_PRIVATE, L->fd, 0);
   if (mem == MAP_FAILED) { delete L; return nullptr; }
   L->data = static_cast<const int32_t*>(mem);
-  madvise(mem, L->file_bytes, MADV_SEQUENTIAL);
+  madvise(mem, L->file_bytes, MADV_RANDOM);  // shuffled window order
 
   L->ring.assign(kRingSlots, std::vector<int32_t>(batch * L->window));
   for (auto& r : L->ready) r.store(false);

@@ -198,7 +198,7 @@ def make_handler(data: PortalData):
     return Handler
 
 
-def serve_portal(apps_root: str, port: int = 0, host: str = "0.0.0.0"):
+def serve_portal(apps_root: str, port: int = 0, host: str = "127.0.0.1"):
     """Start the portal; returns (server, bound_port). server.serve_forever()."""
     server = ThreadingHTTPServer((host, port), make_handler(PortalData(apps_root)))
     return server, server.server_address[1]
@@ -208,8 +208,13 @@ def main() -> None:
     p = argparse.ArgumentParser(description="tony-tpu job-history portal")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--apps-root", default=default_apps_root())
+    p.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind address; 0.0.0.0 exposes the portal (job configs + logs) "
+             "to the network — opt in deliberately",
+    )
     args = p.parse_args()
-    server, port = serve_portal(args.apps_root, args.port)
+    server, port = serve_portal(args.apps_root, args.port, host=args.host)
     print(f"portal serving {args.apps_root} on :{port}")
     server.serve_forever()
 

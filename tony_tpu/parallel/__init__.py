@@ -9,6 +9,7 @@ from tony_tpu.parallel.mesh import (
     MESH_AXES,
     MeshShape,
     build_mesh,
+    build_multislice_mesh,
     default_shape,
     get_default_mesh,
     set_default_mesh,
@@ -36,6 +37,7 @@ __all__ = [
     "MoEConfig",
     "Rules",
     "build_mesh",
+    "build_multislice_mesh",
     "default_shape",
     "get_default_mesh",
     "init_moe_params",

@@ -123,7 +123,8 @@ def ring_attention(q, k, v, cfg=None):
     if mesh is None:
         raise RuntimeError(
             "ring attention needs a mesh: call "
-            "tony_tpu.parallel.set_default_mesh(mesh) (build_mesh does this)"
+            "tony_tpu.parallel.set_default_mesh(mesh) (fit() does this "
+            "automatically for its training mesh)"
         )
     return make_ring_attention(mesh)(q, k, v, cfg)
 

@@ -1,4 +1,4 @@
-"""ctypes binding for the native token loader (native/tonyloader.cpp).
+"""ctypes binding for the native token loader (tony_tpu/native/tonyloader.cpp).
 
 The C++ loader prefetches shuffled (seq_len+1)-token windows from a
 memory-mapped corpus on a real thread, off the GIL — the trainer's host step
@@ -22,7 +22,7 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "tonyloader.cpp")
+_SRC = os.path.join(os.path.dirname(__file__), "..", "native", "tonyloader.cpp")
 _LIB_NAME = "libtonyloader.so"
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
