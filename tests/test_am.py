@@ -1,0 +1,76 @@
+"""AM unit tests (no subprocesses): heartbeat accounting, spec-poll liveness.
+
+Regression coverage for the round-1 advisor finding: executors only start
+their heartbeat thread after registration, so a gang that is slow to fully
+assemble must stay alive through GetClusterSpec polls alone.
+"""
+
+import time
+
+import pytest
+
+from tony_tpu.am.app_master import ApplicationMaster
+from tony_tpu.am.session import TaskState
+from tony_tpu.config.config import TonyConfig
+from tony_tpu.rpc import pb
+
+
+@pytest.fixture
+def am(tmp_path):
+    cfg = TonyConfig.load(
+        overrides={
+            "application.name": "t",
+            "application.framework": "generic",
+            "job.worker.instances": 2,
+            "job.worker.command": "true",
+            "task.heartbeat_interval_ms": 100,
+            "task.max_missed_heartbeats": 5,
+        }
+    )
+    a = ApplicationMaster(cfg, "app_test", str(tmp_path))
+    yield a
+    a.events.close()
+
+
+def _age(am, job, idx, seconds):
+    t = am.session.task(job, idx)
+    t.last_heartbeat = time.monotonic() - seconds
+
+
+def test_spec_poll_keeps_registered_task_alive(am):
+    # worker:0 registered early; worker:1 is still PENDING (slow gang).
+    am.session.register("worker", 0, "h", 1000, 0)
+    _age(am, "worker", 0, 100.0)  # way past interval*max_missed = 0.5s
+    # a spec poll arrives (gang not ready -> not ready response, but alive)
+    resp = am.GetClusterSpec(pb.GetClusterSpecRequest(job_name="worker", index=0), None)
+    assert not resp.ready
+    am._check_heartbeats()
+    assert am.session.task("worker", 0).state == TaskState.REGISTERED
+
+
+def test_stale_registered_task_without_polls_is_lost(am):
+    am.session.register("worker", 0, "h", 1000, 0)
+    _age(am, "worker", 0, 100.0)
+    am._check_heartbeats()
+    assert am.session.task("worker", 0).state == TaskState.LOST
+
+
+def test_heartbeat_rpc_refreshes_and_aborts_stale_attempt(am):
+    am.session.register("worker", 0, "h", 1000, 0)
+    _age(am, "worker", 0, 100.0)
+    r = am.Heartbeat(pb.HeartbeatRequest(job_name="worker", index=0, attempt=0), None)
+    assert r.action == pb.HeartbeatResponse.NONE
+    am._check_heartbeats()
+    assert am.session.task("worker", 0).state == TaskState.REGISTERED
+    # stale attempt is ordered to abort
+    r = am.Heartbeat(pb.HeartbeatRequest(job_name="worker", index=0, attempt=7), None)
+    assert r.action == pb.HeartbeatResponse.ABORT
+
+
+def test_cluster_spec_marks_running_when_gang_ready(am):
+    am.session.register("worker", 0, "h", 1000, 0)
+    am.session.register("worker", 1, "h", 1001, 0)
+    resp = am.GetClusterSpec(pb.GetClusterSpecRequest(job_name="worker", index=0), None)
+    assert resp.ready and resp.num_processes == 2
+    assert am.session.task("worker", 0).state == TaskState.RUNNING
+    assert am.session.task("worker", 1).state == TaskState.REGISTERED

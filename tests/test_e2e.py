@@ -319,3 +319,152 @@ def test_application_timeout(tmp_path):
     assert code != 0
     status = read_status(app_dir)
     assert status["state"] == "FAILED"
+
+
+def test_am_sigkill_retry_job_succeeds(tmp_path):
+    """AM fault tolerance (am.retry_count, SURVEY.md section 5 "AM itself
+    restartable via application attempts"): SIGKILL the AM mid-job; the client
+    relaunches it, attempt 2 reaps the orphaned containers from the journal
+    (am.state.json) and relaunches the gang, and the job still succeeds."""
+    import signal
+    import threading
+    import time as _time
+
+    cfg = TonyConfig.load(
+        overrides={
+            **FAST,
+            "application.stage_dir": str(tmp_path),
+            "application.name": "amkill",
+            "application.framework": "generic",
+            "am.retry_count": 1,
+            "job.worker.instances": 2,
+            "job.worker.command": 'python -c "import time; time.sleep(3)"',
+        }
+    )
+    client = TonyClient(cfg)
+    result = {}
+    t = threading.Thread(target=lambda: result.update(code=client.run(quiet=True)))
+    t.start()
+    # wait until attempt 1 has allocated containers (journal exists), then
+    # kill the AM process outright
+    deadline = _time.monotonic() + 30
+    state_path_known = False
+    while _time.monotonic() < deadline:
+        if client._am_proc is not None and os.path.exists(
+            os.path.join(client.app_dir, "am.state.json")
+        ):
+            state_path_known = True
+            break
+        _time.sleep(0.05)
+    assert state_path_known, "AM never journalled its state"
+    am_pid = client._am_proc.pid
+    os.kill(am_pid, signal.SIGKILL)
+    t.join(timeout=90)
+    assert not t.is_alive()
+    assert result["code"] == 0
+    status = read_status(client.app_dir)
+    assert status["state"] == "SUCCEEDED"
+    # the successor attempt recovered and bumped the generation
+    with open(os.path.join(client.app_dir, "am.state.json")) as f:
+        snap = json.load(f)
+    assert snap["am_attempt"] == 1
+    assert snap["generation"] >= 1
+
+
+def test_am_retry_exhausted_returns_failure(tmp_path):
+    """With am.retry_count=0 a vanished AM fails the submission."""
+    import signal
+    import threading
+    import time as _time
+
+    cfg = TonyConfig.load(
+        overrides={
+            **FAST,
+            "application.stage_dir": str(tmp_path),
+            "application.name": "amkill0",
+            "application.framework": "generic",
+            "job.worker.instances": 1,
+            "job.worker.command": 'python -c "import time; time.sleep(10)"',
+        }
+    )
+    client = TonyClient(cfg)
+    result = {}
+    t = threading.Thread(target=lambda: result.update(code=client.run(quiet=True)))
+    t.start()
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline:
+        if client._am_proc is not None and os.path.exists(
+            os.path.join(client.app_dir, "am.addr")
+        ):
+            break
+        _time.sleep(0.05)
+    os.kill(client._am_proc.pid, signal.SIGKILL)
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert result["code"] == 1
+
+
+@pytest.mark.slow
+def test_gang_restart_resumes_from_checkpoint(tmp_path):
+    """Milestone config #5, end-to-end and config-driven: a real fit() job
+    checkpoints per the JOB config (checkpoint.dir / checkpoint.interval_steps
+    -> TONY_CHECKPOINT_* glue), a worker dies mid-training, the gang restarts,
+    and generation 1 RESUMES from the last orbax step instead of step 0."""
+    src = tmp_path / "src"
+    src.mkdir()
+    ckpt_dir = tmp_path / "ckpt"
+    (src / "train.py").write_text(
+        "import logging, os\n"
+        "logging.basicConfig(level=logging.INFO)\n"
+        "from tony_tpu.train import fit, FitConfig\n"
+        "from tony_tpu.train.data import DataConfig\n"
+        "from tony_tpu.models.llama import LlamaConfig\n"
+        "gen = os.environ.get('TONY_GENERATION', '0')\n"
+        "ckpt = os.environ['TONY_CHECKPOINT_DIR']\n"
+        "def durable_steps():\n"
+        "    if not os.path.isdir(ckpt):\n"
+        "        return []\n"
+        "    return [d for d in os.listdir(ckpt) if d.isdigit()]\n"
+        "def maybe_crash(m):\n"
+        "    # die only once a checkpoint is durable, so resume is provable\n"
+        "    if gen == '0' and m['step'] >= 6 and durable_steps():\n"
+        "        os._exit(1)\n"
+        "out = fit(FitConfig(\n"
+        "    model=LlamaConfig.tiny(),\n"
+        "    data=DataConfig(global_batch=8, seq_len=32, vocab_size=128),\n"
+        "    steps=10, log_every=1, on_metrics=maybe_crash))\n"
+        "print('TRAINING DONE', out)\n"
+    )
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "resume",
+            "application.framework": "jax",
+            "application.timeout_s": 240,
+            "restart.policy": "gang",
+            "restart.max_worker_restarts": 2,
+            "checkpoint.dir": str(ckpt_dir),
+            "checkpoint.interval_steps": 2,
+            "job.worker.instances": 1,
+            "job.worker.command": f"{sys.executable} train.py",
+            "job.worker.env": ["JAX_PLATFORMS=cpu"],
+        },
+        src_dir=str(src),
+    )
+    logs_dir = os.path.join(app_dir, "logs")
+    if code != 0:
+        for n in sorted(os.listdir(logs_dir)):
+            print(f"===== {n}", open(os.path.join(logs_dir, n), errors="replace").read()[-3000:])
+    assert code == 0
+    # generation-1 worker resumed from a checkpoint, not step 0
+    attempt1 = [n for n in os.listdir(logs_dir) if "attempt1" in n]
+    assert attempt1, os.listdir(logs_dir)
+    log_text = open(os.path.join(logs_dir, attempt1[0]), errors="replace").read()
+    assert "resumed from checkpoint step" in log_text
+    assert "TRAINING DONE" in log_text
+    # the final checkpoint landed at the last step
+    import re as _re
+
+    resumed = int(_re.search(r"resumed from checkpoint step (\d+)", log_text).group(1))
+    assert resumed >= 2
+    assert any(d.isdigit() and int(d) == 10 for d in os.listdir(ckpt_dir))

@@ -1,0 +1,255 @@
+"""RemoteBackend tests: placement/inventory/labels, transport seam, release,
+and the full submit -> gang -> restart E2E flow through the local transport.
+
+The production transport is ssh; the local transport fakes only the wire, so
+every backend code path here (and the AM/executor stack above it in the E2E
+cases) is genuine — the same testing posture as the reference's MiniCluster
+(SURVEY.md section 4).
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from tony_tpu.cluster.backend import ContainerRequest, InsufficientResources, Resource
+from tony_tpu.cluster.remote import (
+    LocalTransport,
+    RemoteBackend,
+    SshTransport,
+    make_transport,
+)
+from tony_tpu.cluster.tpu_vm import TpuVmBackend, chips_per_host_for
+
+
+def req(name="worker", idx=0, chips=0, label="", argv=None, log_path=""):
+    return ContainerRequest(
+        task_type=name,
+        task_index=idx,
+        resource=Resource(memory_mb=64, cpus=1, tpu_chips=chips),
+        argv=argv or [sys.executable, "-c", "print('hi')"],
+        env={},
+        log_path=log_path,
+        node_label=label,
+    )
+
+
+def make_backend_2hosts(**kwargs):
+    kwargs.setdefault("transport", LocalTransport())
+    kwargs.setdefault(
+        "host_capacity", Resource(memory_mb=256, cpus=4, tpu_chips=4)
+    )
+    b = RemoteBackend(["127.0.0.1", "localhost"], **kwargs)
+    b.start()
+    return b
+
+
+def test_placement_fills_hosts_in_order(tmp_path):
+    b = make_backend_2hosts()
+    try:
+        c1 = b.allocate(req(idx=0, chips=4))
+        c2 = b.allocate(req(idx=1, chips=4))
+        assert c1.host == "127.0.0.1"
+        assert c2.host == "localhost"  # first host's chips are taken
+        with pytest.raises(InsufficientResources):
+            b.allocate(req(idx=2, chips=1))
+    finally:
+        b.stop()
+    # capacity reclaimed on stop/exit
+    assert b.available().tpu_chips == 8
+
+
+def test_node_labels_constrain_placement():
+    b = RemoteBackend(
+        ["127.0.0.1", "localhost"],
+        transport=LocalTransport(),
+        host_capacity=Resource(256, 4, 4),
+        host_labels={"localhost": "highmem"},
+    )
+    b.start()
+    try:
+        c = b.allocate(req(label="highmem"))
+        assert c.host == "localhost"
+        with pytest.raises(ValueError):
+            b.allocate(req(label="no-such-label"))
+    finally:
+        b.stop()
+
+
+def test_completion_callback_and_exit_code(tmp_path):
+    b = make_backend_2hosts()
+    done = []
+    b.set_completion_callback(lambda c, code: done.append((c.container_id, code)))
+    log_path = str(tmp_path / "c.log")
+    c = b.allocate(
+        req(argv=[sys.executable, "-c", "print('out'); raise SystemExit(7)"],
+            log_path=log_path)
+    )
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not done:
+        time.sleep(0.05)
+    b.stop()
+    assert done == [(c.container_id, 7)]
+    # output streamed into the local per-container log
+    assert "out" in open(log_path).read()
+
+
+def test_release_kills_process_group(tmp_path):
+    b = make_backend_2hosts()
+    done = []
+    b.set_completion_callback(lambda c, code: done.append(code))
+    c = b.allocate(req(argv=[sys.executable, "-c", "import time; time.sleep(300)"]))
+    assert c.pid > 0
+    b.release(c.container_id)
+    # released containers never fire the completion callback
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if not _pid_alive(c.pid):
+            break
+        time.sleep(0.05)
+    assert not _pid_alive(c.pid)
+    b.stop()
+    assert done == []
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def test_ssh_transport_command_shape():
+    t = SshTransport()
+    cmd = t._remote_command(
+        ["python", "-m", "tony_tpu.executor"], {"A": "x y", "B": "1"}
+    )
+    # setsid group, pid echo for remote kill, env exported, argv quoted
+    assert cmd.startswith("setsid sh -c 'echo $$; exec env ")
+    assert "A='x y'" in cmd and "B=1" in cmd
+    assert "python -m tony_tpu.executor" in cmd
+
+
+def test_make_transport_names():
+    assert isinstance(make_transport("local"), LocalTransport)
+    assert isinstance(make_transport("ssh"), SshTransport)
+    with pytest.raises(ValueError):
+        make_transport("carrier-pigeon")
+
+
+def test_tpu_vm_is_remote_with_discovery_glue():
+    # explicit hosts: fully functional RemoteBackend with chip inventory
+    b = TpuVmBackend(
+        ["127.0.0.1"], accelerator_type="v5litepod-8", transport=LocalTransport()
+    )
+    assert b.total_capacity().tpu_chips == 8
+    assert chips_per_host_for("v4-32") == 4
+    # discovery path: raises with instructions (no cloud API here)
+    with pytest.raises(RuntimeError, match="cluster.hosts"):
+        TpuVmBackend(accelerator_type="v4-32")
+
+
+# --- E2E through the AM stack (the RemoteBackend MiniCluster posture) --------
+
+
+FAST = {
+    "task.heartbeat_interval_ms": 200,
+    "task.max_missed_heartbeats": 10,
+    "application.timeout_s": 90,
+    "cluster.backend": "remote",
+    "cluster.hosts": "127.0.0.1,127.0.0.1",
+    "cluster.remote_transport": "local",
+}
+
+
+def submit_remote(tmp_path, overrides, src_dir=""):
+    from tony_tpu.cli.client import TonyClient
+    from tony_tpu.config.config import TonyConfig
+
+    cfg = TonyConfig.load(
+        overrides={**FAST, "application.stage_dir": str(tmp_path), **overrides}
+    )
+    client = TonyClient(cfg, src_dir=src_dir)
+    code = client.run(quiet=True)
+    return code, client.app_dir
+
+
+def test_e2e_remote_backend_gang(tmp_path):
+    """Full submit -> gang barrier -> cluster spec -> success through the
+    RemoteBackend (NM-equivalent remote-launch path, VERDICT item 1)."""
+    code, app_dir = submit_remote(
+        tmp_path,
+        {
+            "application.name": "remote-ok",
+            "application.framework": "generic",
+            "job.worker.instances": 2,
+            "job.worker.command": (
+                'python -c "import os, json; '
+                "spec = json.loads(os.environ['TONY_CLUSTER_SPEC']); "
+                'assert len(spec[\'worker\']) == 2"'
+            ),
+        },
+    )
+    assert code == 0
+    import json as _json
+
+    with open(os.path.join(app_dir, "status.json")) as f:
+        status = _json.load(f)
+    assert status["state"] == "SUCCEEDED"
+    # log streaming produced local per-container logs
+    logs = os.listdir(os.path.join(app_dir, "logs"))
+    assert any(n.startswith("worker_0") for n in logs)
+
+
+def test_e2e_remote_backend_gang_restart(tmp_path):
+    """Worker crash under restart.policy=gang through the RemoteBackend:
+    the whole gang is released on the remote hosts and re-launched."""
+    marker = tmp_path / "attempt.marker"
+    script = (
+        f'python -c "import os, sys, time; p={str(marker)!r}; '
+        "open(p, 'a').write('x'); time.sleep(1); "
+        "sys.exit(3 if os.environ['TONY_GENERATION'] == '0' "
+        "and os.environ['TONY_TASK_INDEX'] == '0' else 0)\""
+    )
+    code, app_dir = submit_remote(
+        tmp_path,
+        {
+            "application.name": "remote-restart",
+            "application.framework": "generic",
+            "restart.policy": "gang",
+            "restart.max_worker_restarts": 2,
+            "job.worker.instances": 2,
+            "job.worker.command": script,
+        },
+    )
+    assert code == 0
+    # both workers ran at least twice (gang restart relaunches everyone)
+    assert len(open(marker).read()) >= 3
+
+
+def test_fits_one_fast_fails_per_host_impossible():
+    """Aggregate capacity can mask per-host impossibility: 8 chips over two
+    4-chip hosts fit no 8-chip container — the scheduler must fail fast."""
+    b = make_backend_2hosts()  # 2 hosts x 4 chips
+    try:
+        assert b.fits_one(Resource(64, 1, 4))
+        assert not b.fits_one(Resource(64, 1, 8))
+        from tony_tpu.am.scheduler import SchedulerHooks, TaskScheduler
+        from tony_tpu.am.session import Session
+        from tony_tpu.config.config import TaskTypeSpec
+
+        spec = TaskTypeSpec(name="worker", instances=1, memory_mb=64, cpus=1,
+                            tpu_chips=8, command="true")
+        session = Session({"worker": spec})
+        sched = TaskScheduler(
+            session, b, SchedulerHooks(lambda s, i: None, lambda *a: None),
+            allocation_timeout_s=30,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(InsufficientResources, match="no single host"):
+            sched.schedule_all({"worker": spec})
+        assert time.monotonic() - t0 < 5  # fast, not the allocation timeout
+    finally:
+        b.stop()

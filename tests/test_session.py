@@ -94,3 +94,69 @@ def test_partial_reset_only_named_types():
     reset = s.reset_for_restart({"worker"})
     assert {t.task_id for t in reset} == {"worker:0", "worker:1"}
     assert s.task("ps", 0).attempt == 0
+
+
+def test_touch_refreshes_liveness_and_rejects_stale():
+    s = Session(make_specs(worker=2))
+    s.register("worker", 0, "h", 1, 0)
+    t = s.task("worker", 0)
+    before = t.last_heartbeat
+    assert before > 0
+    assert s.touch("worker", 0)                  # attempt-agnostic (spec poll)
+    assert s.touch("worker", 0, attempt=0)       # current attempt
+    assert not s.touch("worker", 0, attempt=3)   # stale attempt
+    assert not s.touch("worker", 9)              # unknown task
+    assert t.last_heartbeat >= before
+
+
+def test_mark_running_transition_only_from_registered():
+    s = Session(make_specs(worker=1))
+    t = s.task("worker", 0)
+    s.mark_running("worker", 0)            # PENDING: no-op
+    assert t.state == TaskState.PENDING
+    s.register("worker", 0, "h", 1, 0)
+    s.mark_running("worker", 0)
+    assert t.state == TaskState.RUNNING
+    assert t.started_at > 0
+    s.on_task_completed("worker", 0, 0)
+    s.mark_running("worker", 0)            # terminal: no-op
+    assert t.state == TaskState.SUCCEEDED
+
+
+def test_concurrent_registration_heartbeat_restart_stress():
+    """Pin the all-mutation-under-session-lock discipline: hammer register /
+    touch / completion from many threads across a concurrent gang restart and
+    assert the table ends consistent (no partial resets, no stale survivors).
+    """
+    import threading
+
+    s = Session(make_specs(worker=8))
+    stop = threading.Event()
+    errors: list[Exception] = []
+
+    def worker_thread(i: int) -> None:
+        try:
+            while not stop.is_set():
+                t = s.task("worker", i)
+                attempt = t.attempt
+                s.register("worker", i, f"h{i}", 1000 + i, attempt)
+                s.touch("worker", i, attempt)
+                s.mark_running("worker", i)
+                s.cluster_spec_json()
+                s.rank_table()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker_thread, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for _ in range(20):
+        s.reset_for_restart(None)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+    gen = s.generation
+    assert gen == 20
+    attempts = {t.attempt for t in s.tasks.values()}
+    assert attempts == {20}

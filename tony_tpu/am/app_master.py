@@ -40,13 +40,20 @@ log = logging.getLogger(__name__)
 class ApplicationMaster(ApplicationRpcServicer):
     """One instance per job. ``run()`` blocks until the job is terminal."""
 
-    def __init__(self, config: TonyConfig, app_id: str, app_dir: str):
+    def __init__(self, config: TonyConfig, app_id: str, app_dir: str, am_attempt: int = 0):
         self.config = config
         self.app_id = app_id
         self.app_dir = app_dir
+        self.am_attempt = am_attempt
         self.specs: dict[str, TaskTypeSpec] = config.task_specs()
         if not self.specs:
             raise ValueError("no job types configured (need job.<type>.instances)")
+        max_total = config.get_int(Keys.TASK_MAX_TOTAL_INSTANCES, -1)
+        total = sum(s.instances for s in self.specs.values())
+        if 0 <= max_total < total:
+            raise ValueError(
+                f"{total} task instances exceed task.max_total_instances={max_total}"
+            )
         chief = "chief" if "chief" in self.specs else ""
         # AM-side pre-schedule validation hook (reference: Framework.AMAdapter
         # validateConfig), e.g. mxnet requiring exactly one scheduler.
@@ -54,7 +61,7 @@ class ApplicationMaster(ApplicationRpcServicer):
 
         make_runtime(config.get_str(Keys.APPLICATION_FRAMEWORK, "jax")).validate(config)
         self.session = Session(self.specs, chief_type=chief)
-        self.backend = make_backend(config.get_str(Keys.CLUSTER_BACKEND, "local"))
+        self.backend = make_backend(config.get_str(Keys.CLUSTER_BACKEND, "local"), config)
         self.events = EventWriter(
             app_id,
             config.get_str(Keys.HISTORY_INTERMEDIATE_DIR)
@@ -92,7 +99,7 @@ class ApplicationMaster(ApplicationRpcServicer):
             "TONY_JOB_NAME": spec.name,
             "TONY_TASK_INDEX": str(index),
             "TONY_ATTEMPT": str(attempt),
-            "TONY_AM_ADDR": f"127.0.0.1:{self.port}",
+            "TONY_AM_ADDR": f"{self.backend.am_advertise_host()}:{self.port}",
             "TONY_CONF_PATH": os.path.join(self.app_dir, "config.json"),
             **spec.env,
         }
@@ -109,16 +116,18 @@ class ApplicationMaster(ApplicationRpcServicer):
             node_label=spec.node_label,
         )
 
-    def _on_allocated(self, job_name: str, index: int, cid: str, log_path: str) -> None:
+    def _on_allocated(self, job_name: str, index: int, container: Container, log_path: str) -> None:
         t = self.session.task(job_name, index)
         if t is not None:
             t.log_path = log_path
+            t.container_pid = container.pid
         self.events.emit(
             EventType.TASK_STARTED,
             task=f"{job_name}:{index}",
-            container=cid,
+            container=container.container_id,
             attempt=t.attempt if t else 0,
         )
+        self._write_am_state()
 
     # --- RPC handlers (executor-facing) -------------------------------------
 
@@ -142,17 +151,19 @@ class ApplicationMaster(ApplicationRpcServicer):
         )
 
     def GetClusterSpec(self, request, context):  # noqa: N802
-        task = self.session.task(request.job_name, request.index)
-        if task is None:
+        # A poll proves liveness — but only for the CURRENT attempt: a ghost
+        # from before a gang restart must neither refresh the replacement's
+        # heartbeat nor receive the new generation's spec.
+        if not self.session.touch(request.job_name, request.index, request.attempt):
             return pb.GetClusterSpecResponse(ready=False)
+        task = self.session.task(request.job_name, request.index)
         if self._scheduler_mode == "FCFS":
             ready = self._fcfs_ready(request.job_name)
         else:
             ready = self.session.all_registered()
         if not ready:
             return pb.GetClusterSpecResponse(ready=False)
-        if task.state == TaskState.REGISTERED:
-            task.state = TaskState.RUNNING
+        self.session.mark_running(request.job_name, request.index)
         table = self.session.rank_table()
         coord = self.session.coordinator_task()
         return pb.GetClusterSpecResponse(
@@ -179,10 +190,9 @@ class ApplicationMaster(ApplicationRpcServicer):
         )
 
     def Heartbeat(self, request, context):  # noqa: N802
-        task = self.session.task(request.job_name, request.index)
-        if task is None or request.attempt != task.attempt or self._killed.is_set():
+        alive = self.session.touch(request.job_name, request.index, request.attempt)
+        if not alive or self._killed.is_set():
             return pb.HeartbeatResponse(action=pb.HeartbeatResponse.ABORT)
-        task.last_heartbeat = time.monotonic()
         return pb.HeartbeatResponse(action=pb.HeartbeatResponse.NONE)
 
     def RegisterExecutionResult(self, request, context):  # noqa: N802
@@ -252,6 +262,72 @@ class ApplicationMaster(ApplicationRpcServicer):
                 for t in self.session.tasks.values()
             ]
 
+    # --- AM fault tolerance (am.retry_count) ---------------------------------
+
+    def _am_state_path(self) -> str:
+        return os.path.join(self.app_dir, "am.state.json")
+
+    def _write_am_state(self) -> None:
+        """Journal the minimum a successor AM attempt needs: which container
+        process groups exist (to reap orphans) and the restart generation
+        (so events/metrics stay monotonic across AM attempts)."""
+        with self.session.lock:
+            snap = {
+                "am_attempt": self.am_attempt,
+                "generation": self.session.generation,
+                "containers": {
+                    t.task_id: {
+                        "pid": t.container_pid,
+                        "host": t.host,
+                        "attempt": t.attempt,
+                    }
+                    for t in self.session.tasks.values()
+                    if t.container_pid
+                },
+            }
+        path = self._am_state_path()
+        with open(path + ".tmp", "w") as f:
+            json.dump(snap, f)
+        os.replace(path + ".tmp", path)
+
+    def _recover_from_previous_attempt(self) -> None:
+        """Attempt N+1 startup: reap the predecessor's orphaned container
+        process groups, then carry the restart generation forward so the whole
+        gang relaunches cleanly (fixed-topology barrier-restart semantics —
+        the relaunched workers resume from the last checkpoint via the
+        checkpoint.dir glue)."""
+        try:
+            with open(self._am_state_path()) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            return
+        for tid, info in snap.get("containers", {}).items():
+            pid = int(info.get("pid", 0))
+            if pid <= 0:
+                continue
+            # route through the backend: for remote backends the pid is a
+            # process group on another host, not a local one
+            self.backend.kill_orphan(str(info.get("host", "")), pid)
+            log.warning("reaped orphan container pg %d (%s)", pid, tid)
+        with self.session.lock:
+            self.session.generation = int(snap.get("generation", 0)) + 1
+            # tasks start PENDING at attempt 0 in the fresh table; bump each
+            # to one past the journalled attempt so any orphan that survived
+            # the kill and still heartbeats is told to ABORT.
+            for tid, info in snap.get("containers", {}).items():
+                t = self.session.tasks.get(tid)
+                if t is not None:
+                    t.attempt = int(info.get("attempt", 0)) + 1
+        self.events.emit(
+            EventType.METADATA,
+            am_attempt=self.am_attempt,
+            recovered_generation=self.session.generation,
+        )
+        log.warning(
+            "AM attempt %d recovered: generation -> %d",
+            self.am_attempt, self.session.generation,
+        )
+
     # --- backend callback ----------------------------------------------------
 
     def _on_container_completed(self, container: Container, code: int) -> None:
@@ -275,27 +351,42 @@ class ApplicationMaster(ApplicationRpcServicer):
                     "application.security.enabled but no app.token staged"
                 )
         self._server, self.port = serve(
-            self, port=self.config.get_int(Keys.AM_RPC_PORT, 0), token=token
+            self,
+            port=self.config.get_int(Keys.AM_RPC_PORT, 0),
+            max_workers=max(16, self.config.get_int(Keys.AM_CPUS, 1) * 8),
+            token=token,
         )
         # The client discovers the AM address from this file (the YARN
         # application-report analogue).
         addr_path = os.path.join(self.app_dir, "am.addr")
         with open(addr_path + ".tmp", "w") as f:
-            f.write(f"127.0.0.1:{self.port}")
+            f.write(f"{self.backend.am_advertise_host()}:{self.port}")
         os.replace(addr_path + ".tmp", addr_path)
         self.events.emit(
             EventType.APPLICATION_INITED,
             specs={n: s.to_dict() for n, s in self.specs.items()},
             framework=self.config.get_str(Keys.APPLICATION_FRAMEWORK),
+            queue=self.config.get_str(Keys.APPLICATION_QUEUE, "default"),
+            tags=self.config.get_list(Keys.APPLICATION_TAGS),
         )
         self.backend.set_completion_callback(self._on_container_completed)
         self.backend.start()
+        # The AM's own footprint consumes inventory, like a YARN AM container.
+        self.backend.reserve(
+            Resource(
+                self.config.get_int(Keys.AM_MEMORY_MB, 2048),
+                self.config.get_int(Keys.AM_CPUS, 1),
+                0,
+            )
+        )
         self.session.state = JobState.RUNNING
         deadline = None
         timeout_s = self.config.get_int(Keys.APPLICATION_TIMEOUT_S, 0)
         if timeout_s > 0:
             deadline = time.monotonic() + timeout_s
         try:
+            if self.am_attempt > 0:
+                self._recover_from_previous_attempt()
             self.scheduler.schedule_all(self.specs)
             self._supervise(deadline)
         except Exception as e:
@@ -432,6 +523,7 @@ class ApplicationMaster(ApplicationRpcServicer):
         for cid in cids:
             self.backend.release(cid)
         self.session.reset_for_restart(None)
+        self._write_am_state()
         self._drain_notifications()
         self.scheduler.schedule_all(self.specs)
 
@@ -449,11 +541,13 @@ class ApplicationMaster(ApplicationRpcServicer):
                 t.state = TaskState.PENDING
                 t.host, t.port = "", 0
                 t.container_id = ""
+                t.container_pid = 0
                 t.exit_code = None
                 t.attempt += 1
                 t.restarts += 1
                 t.last_heartbeat = 0.0
         log.warning("restarting %s", ", ".join(t.task_id for t in victims))
+        self._write_am_state()
         self.scheduler.schedule_all(self.specs)
 
     def _drain_notifications(self) -> None:
@@ -483,6 +577,8 @@ class ApplicationMaster(ApplicationRpcServicer):
             "exit_code": code,
             "diagnostics": self.session.diagnostics,
             "tensorboard_url": self.session.tensorboard_url,
+            "queue": self.config.get_str(Keys.APPLICATION_QUEUE, "default"),
+            "tags": self.config.get_list(Keys.APPLICATION_TAGS),
             "tasks": [
                 {
                     "task": t.task_id,
@@ -511,7 +607,10 @@ def main() -> None:
     config = TonyConfig.from_json(
         open(os.path.join(app_dir, "config.json")).read()
     )
-    am = ApplicationMaster(config, app_id, app_dir)
+    am = ApplicationMaster(
+        config, app_id, app_dir,
+        am_attempt=int(os.environ.get("TONY_AM_ATTEMPT", "0")),
+    )
     code = am.run()
     # Give the client one status-poll interval to observe the final state.
     time.sleep(1.0)

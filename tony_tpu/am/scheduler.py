@@ -40,8 +40,8 @@ class SchedulerHooks:
 
     # builds the executor ContainerRequest for a task instance
     make_request: Callable[[TaskTypeSpec, int], ContainerRequest]
-    # called after a container is granted (records container_id on the task)
-    on_allocated: Callable[[str, int, str, str], None]  # job_name, idx, cid, log_path
+    # called after a container is granted (records container_id/pid, journals)
+    on_allocated: Callable[..., None]  # (job_name, idx, container, log_path)
 
 
 class TaskScheduler:
@@ -108,6 +108,15 @@ class TaskScheduler:
             raise InsufficientResources(
                 f"job needs {total_ask} but cluster capacity is {cap}"
             )
+        for spec in specs.values():
+            one = Resource(spec.memory_mb, spec.cpus, spec.tpu_chips)
+            if not self.backend.fits_one(one):
+                # aggregate capacity can mask a per-host impossibility
+                # (8 chips over two 4-chip hosts); fail fast, don't spin
+                # until the allocation timeout
+                raise InsufficientResources(
+                    f"no single host can fit a {spec.name!r} container ({one})"
+                )
         while not self._stop:
             progress = False
             pending_left = False
@@ -142,9 +151,7 @@ class TaskScheduler:
                     t.container_id = container.container_id
                     t.host = container.host
                     t.started_at = time.time()
-                    self.hooks.on_allocated(
-                        name, t.index, container.container_id, req.log_path
-                    )
+                    self.hooks.on_allocated(name, t.index, container, req.log_path)
                     progress = True
             if not pending_left and all(
                 t.state != TaskState.PENDING for t in self.session.tasks.values()

@@ -50,6 +50,7 @@ class Task:
     host: str = ""
     port: int = 0
     container_id: str = ""
+    container_pid: int = 0       # process-group leader on the container host
     exit_code: int | None = None
     attempt: int = 0             # bumped on every restart
     restarts: int = 0
@@ -112,6 +113,33 @@ class Session:
             t.state = TaskState.REGISTERED
             t.last_heartbeat = time.monotonic()
             return True
+
+    def touch(self, job_name: str, index: int, attempt: int | None = None) -> bool:
+        """Record executor liveness under the lock. Returns False for
+        unknown/stale tasks (the caller should order an abort).
+
+        Called from both the Heartbeat handler and GetClusterSpec polls:
+        a registered executor spinning on the gang barrier is alive even
+        though its heartbeat thread hasn't started yet — without this,
+        gangs that take longer than heartbeat_interval*max_missed to
+        assemble (dependency chains, capacity queueing) would have their
+        early registrants spuriously marked LOST.
+        """
+        with self.lock:
+            t = self.task(job_name, index)
+            if t is None or (attempt is not None and attempt != t.attempt):
+                return False
+            t.last_heartbeat = time.monotonic()
+            return True
+
+    def mark_running(self, job_name: str, index: int) -> None:
+        """REGISTERED -> RUNNING transition (cluster spec delivered)."""
+        with self.lock:
+            t = self.task(job_name, index)
+            if t is not None and t.state == TaskState.REGISTERED:
+                t.state = TaskState.RUNNING
+                t.started_at = time.time()
+                t.last_heartbeat = time.monotonic()
 
     def all_registered(self) -> bool:
         """The gang barrier: every instance of every type has registered.
@@ -232,6 +260,7 @@ class Session:
                 t.state = TaskState.PENDING
                 t.host, t.port = "", 0
                 t.container_id = ""
+                t.container_pid = 0
                 t.exit_code = None
                 t.attempt += 1
                 t.restarts += 1

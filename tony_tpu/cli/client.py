@@ -79,7 +79,7 @@ class TonyClient:
             # (0600), required on every control-plane RPC.
             self._token = mint_token(self.app_dir)
 
-    def launch_am(self) -> None:
+    def launch_am(self, am_attempt: int = 0) -> None:
         am_log = open(os.path.join(self.app_dir, "am.log"), "ab")
         env = dict(os.environ)
         # Make the tony_tpu package importable in the AM (and, transitively,
@@ -88,6 +88,13 @@ class TonyClient:
 
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(tony_tpu.__file__)))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["TONY_AM_ATTEMPT"] = str(am_attempt)
+        self._am_attempt = am_attempt
+        # a stale address file would point the monitor at the dead AM
+        try:
+            os.remove(os.path.join(self.app_dir, "am.addr"))
+        except OSError:
+            pass
         self._am_proc = subprocess.Popen(
             [sys.executable, "-m", "tony_tpu.am.app_master", self.app_dir],
             stdout=am_log,
@@ -95,7 +102,10 @@ class TonyClient:
             start_new_session=True,
             env=env,
         )
-        log.info("launched AM pid=%d app_dir=%s", self._am_proc.pid, self.app_dir)
+        log.info(
+            "launched AM pid=%d attempt=%d app_dir=%s",
+            self._am_proc.pid, am_attempt, self.app_dir,
+        )
 
     def am_address(self, timeout_s: float = 30.0) -> str:
         path = os.path.join(self.app_dir, "am.addr")
@@ -115,7 +125,32 @@ class TonyClient:
     # --- tracking -------------------------------------------------------------
 
     def monitor(self, poll_interval_s: float = 1.0, quiet: bool = False) -> int:
-        """Poll status until terminal; mirrors the reference client's report loop."""
+        """Poll status until terminal, relaunching a dead AM up to
+        am.retry_count times (the YARN application-attempt analogue: the RM
+        role the client plays on the local substrate includes AM retries)."""
+        max_retries = self.config.get_int(Keys.AM_RETRY_COUNT, 0)
+        while True:
+            try:
+                code = self._monitor_attempt(poll_interval_s, quiet)
+            except (RuntimeError, TimeoutError) as e:
+                # am_address() failures (AM died before publishing its
+                # address) consume a retry like any other AM death
+                log.warning("AM attempt unusable: %s", e)
+                code = None
+            if code is not None:
+                return code
+            # AM vanished mid-job without a terminal status file.
+            attempt = getattr(self, "_am_attempt", 0)
+            if attempt >= max_retries:
+                log.error("AM vanished without status.json; retries exhausted")
+                return 1
+            if not quiet:
+                print(f"[{self.app_id}] AM died; relaunching (attempt {attempt + 1})")
+            self.launch_am(am_attempt=attempt + 1)
+
+    def _monitor_attempt(self, poll_interval_s: float, quiet: bool) -> int | None:
+        """One AM attempt's report loop. Returns the final exit code, or
+        None if the AM vanished before reaching a terminal state."""
         addr = self.am_address()
         client = ApplicationRpcClient(addr, token=getattr(self, "_token", None))
         last_states: dict[str, str] = {}
@@ -125,6 +160,13 @@ class TonyClient:
                 try:
                     status = client.get_application_status()
                 except grpc.RpcError:
+                    if self._am_proc is not None and self._am_proc.poll() is None:
+                        # AM process alive: transient RPC failure (deadline,
+                        # thread-pool pressure) — keep polling, do NOT declare
+                        # the attempt dead or we'd launch a duplicate AM that
+                        # reaps the live one's containers.
+                        time.sleep(poll_interval_s)
+                        continue
                     # AM gone: fall back to the status file it wrote on exit.
                     return self._final_from_status_file()
                 if not quiet:
@@ -156,17 +198,18 @@ class TonyClient:
         except subprocess.TimeoutExpired:
             self._am_proc.terminate()
 
-    def _final_from_status_file(self) -> int:
+    def _final_from_status_file(self) -> int | None:
+        """Exit code from the AM's final status file, or None if the AM died
+        without writing one (the caller may retry the AM)."""
         path = os.path.join(self.app_dir, "status.json")
-        for _ in range(50):
+        for _ in range(25):
             if os.path.exists(path):
                 with open(path) as f:
                     status = json.load(f)
                 print(f"[{self.app_id}] {status['state']} (from status.json)")
                 return int(status["exit_code"])
             time.sleep(0.2)
-        log.error("AM vanished without status.json")
-        return 1
+        return None
 
     # --- one-shot -------------------------------------------------------------
 

@@ -9,16 +9,38 @@ from tony_tpu.cluster.backend import (
     Resource,
 )
 from tony_tpu.cluster.local import LocalProcessBackend
+from tony_tpu.cluster.remote import LocalTransport, RemoteBackend, SshTransport
 from tony_tpu.cluster.tpu_vm import TpuVmBackend
 
 
-def make_backend(name: str, **kwargs) -> ClusterBackend:
-    """Backend factory keyed by the ``cluster.backend`` config value."""
+def make_backend(name: str, config=None, **kwargs) -> ClusterBackend:
+    """Backend factory keyed by the ``cluster.backend`` config value.
+
+    ``config`` (a TonyConfig) supplies the remote backends' host list,
+    transport, and chip inventory; the local backend needs none of it.
+    """
     if name == "local":
         return LocalProcessBackend(**kwargs)
-    if name == "tpu_vm":
-        return TpuVmBackend(**kwargs)
-    raise ValueError(f"unknown cluster backend {name!r} (expected local | tpu_vm)")
+    if name in ("remote", "tpu_vm"):
+        if config is not None:
+            from tony_tpu.config.keys import Keys
+
+            kwargs.setdefault("hosts", config.get_list(Keys.CLUSTER_HOSTS))
+            kwargs.setdefault(
+                "transport", config.get_str(Keys.CLUSTER_REMOTE_TRANSPORT, "ssh")
+            )
+            chips = config.get_int(Keys.CLUSTER_TPU_CHIPS_PER_HOST, 4)
+            if name == "remote":
+                kwargs.setdefault(
+                    "host_capacity",
+                    Resource(memory_mb=1 << 20, cpus=256, tpu_chips=chips),
+                )
+            else:
+                kwargs.setdefault("chips_per_host", chips)
+        return RemoteBackend(**kwargs) if name == "remote" else TpuVmBackend(**kwargs)
+    raise ValueError(
+        f"unknown cluster backend {name!r} (expected local | remote | tpu_vm)"
+    )
 
 
 __all__ = [
@@ -28,7 +50,10 @@ __all__ = [
     "ContainerState",
     "InsufficientResources",
     "LocalProcessBackend",
+    "LocalTransport",
+    "RemoteBackend",
     "Resource",
+    "SshTransport",
     "TpuVmBackend",
     "make_backend",
 ]

@@ -5,15 +5,18 @@ addContainerRequest`` with (memory, vcores, yarn.io/gpu=n) and launches
 executors through ``NMClientAsync.startContainer`` (SURVEY.md sections 1, 3.1).
 There is no YARN here, so the substrate itself is a pluggable
 ``ClusterBackend`` with a first-class ``tpu`` resource type (the
-``yarn.io/tpu`` analogue from BASELINE.json's north star). Two backends:
+``yarn.io/tpu`` analogue from BASELINE.json's north star). Backends:
 
 - :class:`~tony_tpu.cluster.local.LocalProcessBackend` — containers are local
   subprocesses against a fake inventory. This is both the dev/test substrate
   (the tony-mini ``MiniCluster`` lesson, SURVEY.md section 4) and the
   single-host production path.
-- :class:`~tony_tpu.cluster.tpu_vm.TpuVmBackend` — a documented stub mapping
-  the same protocol onto GCE TPU-VM pod-slice hosts (no cloud creds in the
-  image; gated behind NotImplementedError).
+- :class:`~tony_tpu.cluster.remote.RemoteBackend` — containers are processes
+  on a fixed set of remote hosts over a pluggable transport (ssh in
+  production, local subprocesses in tests).
+- :class:`~tony_tpu.cluster.tpu_vm.TpuVmBackend` — RemoteBackend plus TPU
+  slice host discovery (explicit ``cluster.hosts`` today; Cloud TPU API
+  discovery raises with instructions — no cloud creds in this image).
 """
 
 from __future__ import annotations
@@ -71,7 +74,7 @@ class ContainerRequest:
     argv: Sequence[str]             # executor launch command
     env: Mapping[str, str] = field(default_factory=dict)
     log_path: str = ""              # container stdout+stderr destination
-    node_label: str = ""            # placement hint (ignored by local backend)
+    node_label: str = ""            # placement constraint (RemoteBackend labels)
 
     @property
     def task_id(self) -> str:
@@ -80,7 +83,12 @@ class ContainerRequest:
 
 @dataclass
 class Container:
-    """A granted container. ``host`` feeds cluster-spec assembly."""
+    """A granted container. ``host`` feeds cluster-spec assembly.
+
+    ``pid`` is the container's process-group leader on its host (0 when the
+    backend has no such notion); the AM journals it so a restarted AM attempt
+    can reap orphans from its predecessor.
+    """
 
     container_id: str
     host: str
@@ -88,6 +96,7 @@ class Container:
     request: ContainerRequest
     state: ContainerState = ContainerState.RUNNING
     exit_code: int | None = None
+    pid: int = 0
 
 
 # (container, exit_code) — fired from a backend thread when a container's
@@ -110,9 +119,37 @@ class ClusterBackend(Protocol):
         """Release every container and shut down."""
         ...
 
+    def am_advertise_host(self) -> str:
+        """The host executors should dial to reach AM-side services.
+
+        Loopback is only correct when containers share the AM's host; a
+        remote backend must return an externally-reachable address or every
+        remote registration would silently dial the wrong machine.
+        """
+        ...
+
+    def reserve(self, r: Resource) -> None:
+        """Permanently claim capacity for out-of-band consumers (the AM's
+        own footprint). Called once at AM startup."""
+        ...
+
+    def kill_orphan(self, host: str, pid: int) -> None:
+        """Kill a process group journalled by a previous AM attempt.
+
+        ``host`` is where the group lives; a local backend may ignore it, a
+        remote backend must route the kill through its transport.
+        """
+        ...
+
     def total_capacity(self) -> Resource: ...
 
     def available(self) -> Resource: ...
+
+    def fits_one(self, r: Resource) -> bool:
+        """Could a single container of this size EVER be placed (empty
+        cluster)? Aggregate capacity is not enough for per-host backends:
+        8 chips across two 4-chip hosts fit no 8-chip container."""
+        ...
 
     def allocate(self, request: ContainerRequest) -> Container:
         """Grant + launch a container, or raise :class:`InsufficientResources`."""
@@ -155,6 +192,15 @@ class _InventoryMixin:
     def _reclaim(self, r: Resource) -> None:
         with self._inv_lock:
             self._in_use = self._in_use - r
+
+    def reserve(self, r: Resource) -> None:
+        """Permanently claim capacity for out-of-band consumers — the AM
+        reserves its own footprint (am.memory_mb/am.cpus) here, the way a
+        YARN AM container consumes queue capacity."""
+        self._claim(r)
+
+    def fits_one(self, r: Resource) -> bool:
+        return r.fits_in(self._capacity)
 
 
 __all__ = [

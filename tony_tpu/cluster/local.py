@@ -48,12 +48,32 @@ class LocalProcessBackend(_InventoryMixin):
     def start(self) -> None:
         self._stopped = False
 
+    def am_advertise_host(self) -> str:
+        # Containers are subprocesses on this host; loopback is correct.
+        return "127.0.0.1"
+
+    def kill_orphan(self, host: str, pid: int) -> None:
+        # all containers live on this host; host is informational
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
     def set_completion_callback(self, cb: CompletionCallback) -> None:
         self._cb = cb
 
     def allocate(self, request: ContainerRequest) -> Container:
         if self._stopped:
             raise InsufficientResources("backend stopped")
+        if request.node_label:
+            # One host, no labels: honour the ask by refusing it rather than
+            # silently placing anywhere (RemoteBackend implements labels).
+            # ValueError, not InsufficientResources: the scheduler retries the
+            # latter, and no amount of waiting invents a labelled host.
+            raise ValueError(
+                f"LocalProcessBackend has no node labels (asked {request.node_label!r}); "
+                "use cluster.backend='remote' for labelled placement"
+            )
         self._claim(request.resource)
         try:
             with self._lock:
@@ -85,6 +105,7 @@ class LocalProcessBackend(_InventoryMixin):
             resource=request.resource,
             request=request,
             state=ContainerState.RUNNING,
+            pid=proc.pid,
         )
         with self._lock:
             self._containers[cid] = container

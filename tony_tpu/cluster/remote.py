@@ -1,0 +1,433 @@
+"""RemoteBackend: containers are processes on remote hosts.
+
+The multi-host resource substrate — the NMClientAsync role of the reference's
+YARN NodeManagers (SURVEY.md sections 1 L0, 3.1 "startContainer"): the AM
+launches executors on a fixed set of worker hosts (a TPU pod slice's TPU-VM
+workers in production), streams their output back to local per-container log
+files, kills remote process groups on release, and reports completion through
+the standard callback.
+
+The host-execution mechanism is a pluggable :class:`Transport` so the entire
+backend — placement, per-host inventory, log streaming, release, completion —
+is exercised by the E2E suite with the ``local`` transport (subprocesses
+playing the part of remote hosts), while production uses ``ssh``. This is the
+same faked-at-the-infrastructure-level testing posture as LocalProcessBackend
+(the tony-mini lesson, SURVEY.md section 4), one level up.
+
+Config surface::
+
+    cluster.backend            = "remote"
+    cluster.hosts              = "10.0.0.1,10.0.0.2"   # pod-slice workers
+    cluster.remote_transport   = "ssh"                  # or "local" (tests)
+    cluster.tpu_chips_per_host = 4                      # v4 hosts
+
+Staging contract: the application dir (config.json, src/, app.token) must be
+visible at the same path on every host — an NFS/GCS mount on TPU-VM slices.
+This replaces the reference's HDFS localisation (SURVEY.md section 3.1); a
+copy-based localiser over the transport is a possible later extension.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import signal
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import IO, Mapping, Protocol, Sequence
+
+from tony_tpu.cluster.backend import (
+    CompletionCallback,
+    Container,
+    ContainerRequest,
+    ContainerState,
+    InsufficientResources,
+    Resource,
+)
+from tony_tpu.utils.net import local_host
+
+log = logging.getLogger(__name__)
+
+
+class RemoteProcess(Protocol):
+    """A launched container process on some host."""
+
+    pid: int  # process-group leader ON THE REMOTE HOST (0 if unknown)
+
+    def wait(self) -> int: ...
+
+    def poll(self) -> int | None: ...
+
+
+class Transport(Protocol):
+    """How to run and kill process groups on a host.
+
+    The seam between the backend's bookkeeping (testable anywhere) and the
+    actual remote-execution mechanism (ssh in production).
+    """
+
+    def exec_on(
+        self,
+        host: str,
+        argv: Sequence[str],
+        env: Mapping[str, str],
+        log_file: IO[bytes],
+    ) -> RemoteProcess: ...
+
+    def kill_pg(self, host: str, pid: int, sig: int) -> None: ...
+
+
+# --- local transport (tests / single-host prod) -----------------------------
+
+
+class _LocalProcess:
+    def __init__(self, proc: subprocess.Popen):
+        self._proc = proc
+        self.pid = proc.pid
+
+    def wait(self) -> int:
+        return self._proc.wait()
+
+    def poll(self) -> int | None:
+        return self._proc.poll()
+
+
+class LocalTransport:
+    """Runs "remote" containers as local subprocesses.
+
+    Every RemoteBackend code path above the transport seam is genuine; only
+    the wire is faked. Also the honest choice for a single-host deployment.
+    """
+
+    def exec_on(self, host, argv, env, log_file):
+        full_env = dict(os.environ)
+        full_env.update(env)
+        proc = subprocess.Popen(
+            list(argv),
+            env=full_env,
+            stdout=log_file,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        return _LocalProcess(proc)
+
+    def kill_pg(self, host, pid, sig):
+        try:
+            os.killpg(pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+# --- ssh transport (production) ----------------------------------------------
+
+
+class _SshProcess:
+    """The local ssh client process; its exit code is the remote command's
+    (ssh propagates it), and the remote pgid is read from the first output
+    line (``echo $$`` under ``setsid`` makes pid == pgid)."""
+
+    def __init__(self, proc: subprocess.Popen, pid: int):
+        self._proc = proc
+        self.pid = pid
+
+    def wait(self) -> int:
+        return self._proc.wait()
+
+    def poll(self) -> int | None:
+        return self._proc.poll()
+
+
+class SshTransport:
+    """Launch containers over ssh.
+
+    The remote command wraps the executor in ``setsid`` so the whole user
+    process tree forms one process group, reports that group's id on the
+    first line of output (captured locally, not written to the log), then
+    execs the real argv with the env exported. Output streams back over the
+    ssh channel into the local per-container log file — the YARN
+    log-aggregation analogue with zero remote-side daemons.
+    """
+
+    # ConnectTimeout bounds a blackholed host: without it the pid-line read in
+    # exec_on blocks the scheduler thread past every allocation timeout.
+    def __init__(
+        self,
+        ssh_argv: Sequence[str] = (
+            "ssh", "-o", "BatchMode=yes", "-o", "ConnectTimeout=15",
+            "-o", "ServerAliveInterval=30", "-o", "ServerAliveCountMax=4",
+        ),
+    ):
+        self._ssh = list(ssh_argv)
+
+    def _remote_command(self, argv: Sequence[str], env: Mapping[str, str]) -> str:
+        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in sorted(env.items()))
+        inner = " ".join(shlex.quote(a) for a in argv)
+        # setsid => new session, sid == pid of the sh; echo it before exec.
+        return f"setsid sh -c 'echo $$; exec env {exports} {inner}'"
+
+    def exec_on(self, host, argv, env, log_file):
+        proc = subprocess.Popen(
+            self._ssh + [host, self._remote_command(argv, env)],
+            stdout=subprocess.PIPE,
+            stderr=log_file,
+            start_new_session=True,
+        )
+        pid_line = proc.stdout.readline().strip()
+        try:
+            remote_pid = int(pid_line)
+        except ValueError:
+            remote_pid = 0
+        # after the pid line, pump the rest of stdout into the log file
+        t = threading.Thread(
+            target=self._pump, args=(proc.stdout, log_file), daemon=True
+        )
+        t.start()
+        return _SshProcess(proc, remote_pid)
+
+    @staticmethod
+    def _pump(src, dst) -> None:
+        try:
+            for chunk in iter(lambda: src.read(8192), b""):
+                dst.write(chunk)
+                dst.flush()
+        except (OSError, ValueError):
+            pass
+
+    def kill_pg(self, host, pid, sig):
+        if pid <= 0:
+            return
+        subprocess.run(
+            self._ssh + [host, f"kill -{sig} -- -{pid}"],
+            capture_output=True,
+            timeout=30,
+        )
+
+
+def make_transport(name: str) -> Transport:
+    if name == "local":
+        return LocalTransport()
+    if name == "ssh":
+        return SshTransport()
+    raise ValueError(f"unknown remote transport {name!r} (expected ssh | local)")
+
+
+# --- the backend --------------------------------------------------------------
+
+
+@dataclass
+class _HostSlot:
+    host: str
+    capacity: Resource
+    in_use: Resource = field(default_factory=lambda: Resource(0, 0, 0))
+    label: str = ""
+
+    def available(self) -> Resource:
+        return self.capacity - self.in_use
+
+
+class RemoteBackend:
+    """Containers on a fixed inventory of remote hosts.
+
+    Placement: first host whose remaining capacity fits the ask (and whose
+    label matches the request's ``node_label``, if any) — hosts in config
+    order, so task types land deterministically. The slice topology is fixed;
+    elastic restart above this layer re-launches on the same hosts.
+    """
+
+    def __init__(
+        self,
+        hosts: Sequence[str],
+        *,
+        transport: Transport | str = "ssh",
+        host_capacity: Resource | None = None,
+        host_labels: Mapping[str, str] | None = None,
+    ):
+        if not hosts:
+            raise ValueError("RemoteBackend needs at least one host (cluster.hosts)")
+        cap = host_capacity or Resource(memory_mb=1 << 20, cpus=256, tpu_chips=4)
+        self._hosts = [
+            _HostSlot(h, cap, label=(host_labels or {}).get(h, "")) for h in hosts
+        ]
+        self.transport: Transport = (
+            make_transport(transport) if isinstance(transport, str) else transport
+        )
+        self._containers: dict[str, Container] = {}
+        self._procs: dict[str, RemoteProcess] = {}
+        self._logs: dict[str, IO[bytes]] = {}
+        self._slot_of: dict[str, _HostSlot] = {}
+        self._released: set[str] = set()
+        self._waiters: dict[str, threading.Thread] = {}
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._cb: CompletionCallback | None = None
+        self._stopped = False
+
+    # --- protocol -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._stopped = False
+
+    def am_advertise_host(self) -> str:
+        # remote executors must dial back across the network, never loopback
+        return local_host()
+
+    def kill_orphan(self, host: str, pid: int) -> None:
+        self.transport.kill_pg(host, pid, signal.SIGKILL)
+
+    def set_completion_callback(self, cb: CompletionCallback) -> None:
+        self._cb = cb
+
+    def total_capacity(self) -> Resource:
+        total = Resource(0, 0, 0)
+        for s in self._hosts:
+            total = total + s.capacity
+        return total
+
+    def available(self) -> Resource:
+        with self._lock:
+            total = Resource(0, 0, 0)
+            for s in self._hosts:
+                total = total + s.available()
+            return total
+
+    def fits_one(self, r: Resource) -> bool:
+        return any(r.fits_in(s.capacity) for s in self._hosts)
+
+    def reserve(self, r: Resource) -> None:
+        """AM footprint: the AM runs on the client/coordinator host, not on a
+        worker host, so reservation is accounted against the first host only
+        when it is this machine; otherwise it is free."""
+        with self._lock:
+            for s in self._hosts:
+                if s.host in ("127.0.0.1", "localhost", local_host()):
+                    if r.fits_in(s.available()):
+                        s.in_use = s.in_use + r
+                    return
+
+    def _place(self, request: ContainerRequest) -> _HostSlot:
+        if request.node_label and not any(
+            s.label == request.node_label for s in self._hosts
+        ):
+            # no amount of waiting invents a labelled host: fail fast
+            raise ValueError(f"no host carries node label {request.node_label!r}")
+        for s in self._hosts:
+            if request.node_label and s.label != request.node_label:
+                continue
+            if request.resource.fits_in(s.available()):
+                return s
+        raise InsufficientResources(
+            f"no host fits {request.resource} (label={request.node_label!r})"
+        )
+
+    def allocate(self, request: ContainerRequest) -> Container:
+        if self._stopped:
+            raise InsufficientResources("backend stopped")
+        with self._lock:
+            slot = self._place(request)
+            slot.in_use = slot.in_use + request.resource
+            self._next_id += 1
+            cid = f"container_{self._next_id:06d}"
+        if request.log_path:
+            os.makedirs(os.path.dirname(request.log_path) or ".", exist_ok=True)
+            out: IO[bytes] = open(request.log_path, "ab")
+        else:
+            out = open(os.devnull, "ab")
+        env = dict(request.env)
+        env["TONY_CONTAINER_ID"] = cid
+        try:
+            proc = self.transport.exec_on(slot.host, request.argv, env, out)
+        except Exception:
+            out.close()
+            with self._lock:
+                slot.in_use = slot.in_use - request.resource
+            raise
+        container = Container(
+            container_id=cid,
+            host=slot.host,
+            resource=request.resource,
+            request=request,
+            state=ContainerState.RUNNING,
+            pid=proc.pid,
+        )
+        with self._lock:
+            self._containers[cid] = container
+            self._procs[cid] = proc
+            self._logs[cid] = out
+            self._slot_of[cid] = slot
+        waiter = threading.Thread(
+            target=self._wait, args=(cid,), daemon=True, name=f"wait-{cid}"
+        )
+        with self._lock:
+            self._waiters[cid] = waiter
+        waiter.start()
+        log.info(
+            "allocated %s for %s on %s pid=%d",
+            cid, request.task_id, slot.host, proc.pid,
+        )
+        return container
+
+    def _wait(self, cid: str) -> None:
+        proc = self._procs[cid]
+        code = proc.wait()
+        with self._lock:
+            container = self._containers[cid]
+            released = cid in self._released
+            container.exit_code = code
+            container.state = (
+                ContainerState.RELEASED if released else ContainerState.COMPLETED
+            )
+            slot = self._slot_of[cid]
+            slot.in_use = slot.in_use - container.resource
+            logf = self._logs.pop(cid, None)
+        if logf is not None:
+            try:
+                logf.close()
+            except OSError:
+                pass
+        if not released and not self._stopped and self._cb is not None:
+            self._cb(container, code)
+
+    def release(self, container_id: str) -> None:
+        with self._lock:
+            container = self._containers.get(container_id)
+            proc = self._procs.get(container_id)
+            if container is None or container_id in self._released:
+                return
+            self._released.add(container_id)
+        if proc is not None and proc.poll() is None:
+            self.transport.kill_pg(container.host, container.pid, signal.SIGTERM)
+            try:
+                t = self._waiters.get(container_id)
+                if t is not None:
+                    t.join(timeout=3)
+                if proc.poll() is None:
+                    self.transport.kill_pg(container.host, container.pid, signal.SIGKILL)
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stopped = True
+        with self._lock:
+            cids = [c for c in self._procs if c not in self._released]
+            self._released.update(cids)
+        for cid in cids:
+            c = self._containers[cid]
+            if self._procs[cid].poll() is None:
+                self.transport.kill_pg(c.host, c.pid, signal.SIGKILL)
+        for t in list(self._waiters.values()):
+            t.join(timeout=10)
+
+    def containers(self) -> list[Container]:
+        with self._lock:
+            return list(self._containers.values())
+
+
+__all__ = [
+    "LocalTransport",
+    "RemoteBackend",
+    "SshTransport",
+    "Transport",
+    "make_transport",
+]

@@ -1,80 +1,77 @@
-"""TpuVmBackend: pod-slice hosts as containers (documented stub).
+"""TpuVmBackend: pod-slice hosts as containers.
 
 The north star (BASELINE.json) has the AM "allocate TPU-VM pod-slice hosts as
-YARN containers via a yarn.io/tpu resource type". On a real deployment each
-``Container`` maps to one TPU-VM worker host of a pod slice:
+YARN containers via a yarn.io/tpu resource type". This backend is thin
+node-discovery glue over :class:`~tony_tpu.cluster.remote.RemoteBackend`:
+every mechanism — remote launch, log streaming, process-group release,
+completion callbacks, per-host chip inventory — is the RemoteBackend's, which
+the E2E suite exercises with the local transport. What this class adds is
+resolving a slice's worker hostnames:
 
-- ``start()``        -> TPU API ``nodes.create`` (acceleratorType=v4-32 etc.)
-                        or attach to a pre-created slice; discover worker
-                        hostnames from instance metadata.
-- ``allocate(req)``  -> pick the next unassigned worker host; run the executor
-                        argv there over SSH with ``req.env`` exported
-                        (equivalent of NMClientAsync.startContainer).
-- ``release(cid)``   -> kill the remote process group.
-- completion         -> SSH channel exit status -> completion callback.
-- inventory          -> hosts x chips-per-host (v4: 4 chips/host).
+- explicit ``cluster.hosts`` (pre-created slice whose workers you know) — the
+  path that works today; or
+- Cloud TPU API discovery (``tpu.nodes.get`` ``networkEndpoints``) — requires
+  GCE credentials + network, neither of which exists in this image, so that
+  path raises with instructions at ``start()``.
 
-The slice topology is fixed — elastic restart is barrier-restart of the whole
-gang (SURVEY.md section 5 "failure detection"), which the AM implements above
-this layer; the backend only needs to re-launch on the same (or replacement)
-host.
-
-No cloud credentials or network exist in this image, so this backend raises on
-use; the protocol surface is kept identical to LocalProcessBackend so swapping
-backends is a config change (``cluster.backend = "tpu_vm"``).
+Slice topology is fixed: elastic restart is barrier-restart of the whole gang
+(SURVEY.md section 5 "failure detection"), implemented above this layer; the
+backend re-launches on the same hosts.
 """
 
 from __future__ import annotations
 
-from tony_tpu.cluster.backend import (
-    CompletionCallback,
-    Container,
-    ContainerRequest,
-    Resource,
-)
+from typing import Sequence
+
+from tony_tpu.cluster.backend import Resource
+from tony_tpu.cluster.remote import RemoteBackend, Transport
 
 
-class TpuVmBackend:
-    """Stub: same protocol as LocalProcessBackend, gated on cloud access."""
+# chips per TPU-VM worker host by accelerator generation (public machine shapes)
+CHIPS_PER_HOST = {"v4": 4, "v5litepod": 8, "v5p": 4, "v6e": 8}
+
+
+def chips_per_host_for(accelerator_type: str) -> int:
+    family = accelerator_type.split("-")[0]
+    return CHIPS_PER_HOST.get(family, 4)
+
+
+class TpuVmBackend(RemoteBackend):
+    """RemoteBackend + TPU slice host discovery."""
 
     def __init__(
         self,
+        hosts: Sequence[str] = (),
+        *,
         accelerator_type: str = "v4-32",
-        chips_per_host: int = 4,
+        chips_per_host: int = 0,
         zone: str = "",
         project: str = "",
+        node: str = "",
+        transport: Transport | str = "ssh",
     ):
         self.accelerator_type = accelerator_type
-        self.chips_per_host = chips_per_host
         self.zone = zone
         self.project = project
-
-    def _unavailable(self) -> RuntimeError:
-        return RuntimeError(
-            "TpuVmBackend requires Cloud TPU API access (none in this "
-            "environment); use cluster.backend = 'local'"
+        self.node = node
+        chips = chips_per_host or chips_per_host_for(accelerator_type)
+        if not hosts:
+            hosts = self._discover_hosts()
+        super().__init__(
+            hosts,
+            transport=transport,
+            host_capacity=Resource(memory_mb=1 << 20, cpus=256, tpu_chips=chips),
         )
 
-    def start(self) -> None:
-        raise self._unavailable()
-
-    def stop(self) -> None:
-        pass
-
-    def total_capacity(self) -> Resource:
-        raise self._unavailable()
-
-    def available(self) -> Resource:
-        raise self._unavailable()
-
-    def allocate(self, request: ContainerRequest) -> Container:
-        raise self._unavailable()
-
-    def release(self, container_id: str) -> None:
-        raise self._unavailable()
-
-    def set_completion_callback(self, cb: CompletionCallback) -> None:
-        pass
+    def _discover_hosts(self) -> list[str]:
+        """Resolve worker hostnames from the Cloud TPU API (needs creds)."""
+        raise RuntimeError(
+            "TPU-VM host discovery needs the Cloud TPU API (no credentials/"
+            "network in this environment). Set cluster.hosts to the slice's "
+            "worker addresses explicitly, e.g. cluster.hosts = "
+            '"t1v-n-xxxxxxx-w-0,t1v-n-xxxxxxx-w-1" — everything else '
+            "(launch, logs, release) works over ssh from there."
+        )
 
 
-__all__ = ["TpuVmBackend"]
+__all__ = ["CHIPS_PER_HOST", "TpuVmBackend", "chips_per_host_for"]

@@ -15,7 +15,7 @@ import tomllib
 from dataclasses import dataclass, field
 from typing import Any, Iterator
 
-from tony_tpu.config.keys import DEFAULTS, job_key
+from tony_tpu.config.keys import DEFAULTS, Keys, job_key
 
 _ENV_PREFIX = "TONY_CONF_"
 

@@ -22,11 +22,10 @@ class Keys:
     APPLICATION_TAGS = "application.tags"
 
     # --- AM (ApplicationMaster) ---
-    AM_MEMORY_MB = "am.memory_mb"
-    AM_CPUS = "am.cpus"
+    AM_MEMORY_MB = "am.memory_mb"  # reserved from backend inventory by the AM
+    AM_CPUS = "am.cpus"  # ditto; also sizes the AM RPC thread pool
     AM_RETRY_COUNT = "am.retry_count"  # tony.am.retry-count analogue
     AM_RPC_PORT = "am.rpc_port"  # 0 = ephemeral
-    AM_EVENT_DIR = "am.event_dir"  # history event output dir (jhist analogue)
     AM_ALLOCATION_TIMEOUT_S = "am.allocation_timeout_s"  # gang partial-alloc guard
 
     # --- task supervision ---
@@ -56,13 +55,14 @@ class Keys:
     PROFILER_PORT = "profiler.port"
 
     # --- cluster backend ---
-    CLUSTER_BACKEND = "cluster.backend"  # local | tpu_vm (stub)
-    CLUSTER_MAX_CONTAINERS = "cluster.max_containers"
+    # Deliberate non-goals vs the reference key surface: docker keys (no
+    # container runtime in this environment — processes are the container
+    # abstraction) and a max-containers cap (the inventory's memory/cpu/chip
+    # capacity already bounds concurrent containers).
+    CLUSTER_BACKEND = "cluster.backend"  # local | remote | tpu_vm
     CLUSTER_TPU_CHIPS_PER_HOST = "cluster.tpu_chips_per_host"
-
-    # --- docker parity (reference: tony docker keys; local backend ignores) ---
-    DOCKER_ENABLED = "docker.enabled"
-    DOCKER_IMAGE = "docker.image"
+    CLUSTER_HOSTS = "cluster.hosts"  # remote backend: comma list of hosts
+    CLUSTER_REMOTE_TRANSPORT = "cluster.remote_transport"  # ssh | local
 
     # --- portal/history ---
     HISTORY_INTERMEDIATE_DIR = "history.intermediate_dir"
@@ -109,7 +109,6 @@ DEFAULTS: dict[str, object] = {
     Keys.AM_CPUS: 1,
     Keys.AM_RETRY_COUNT: 0,
     Keys.AM_RPC_PORT: 0,
-    Keys.AM_EVENT_DIR: "",
     Keys.AM_ALLOCATION_TIMEOUT_S: 300,
     Keys.TASK_HEARTBEAT_INTERVAL_MS: 1000,
     Keys.TASK_MAX_MISSED_HEARTBEATS: 25,
@@ -128,10 +127,9 @@ DEFAULTS: dict[str, object] = {
     Keys.PROFILER_ENABLED: False,
     Keys.PROFILER_PORT: 9999,
     Keys.CLUSTER_BACKEND: "local",
-    Keys.CLUSTER_MAX_CONTAINERS: 64,
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
-    Keys.DOCKER_ENABLED: False,
-    Keys.DOCKER_IMAGE: "",
+    Keys.CLUSTER_HOSTS: "",
+    Keys.CLUSTER_REMOTE_TRANSPORT: "ssh",
     Keys.HISTORY_INTERMEDIATE_DIR: "",
     Keys.HISTORY_FINISHED_DIR: "",
     Keys.PORTAL_PORT: 8080,

@@ -90,7 +90,9 @@ class TaskExecutor:
         timeout_s = self.config.get_float(Keys.TASK_REGISTRATION_TIMEOUT_S, 300.0)
         deadline = time.monotonic() + timeout_s
         while True:
-            resp = self.client.get_cluster_spec(self.job_name, self.index)
+            if self._abort.is_set():
+                raise SystemExit(ABORT_EXIT_CODE)
+            resp = self.client.get_cluster_spec(self.job_name, self.index, self.attempt)
             if resp.ready:
                 return TaskIdentity.from_cluster_spec_response(
                     self.job_name, self.index, resp
@@ -145,6 +147,11 @@ class TaskExecutor:
             "%s:%d registered at %s:%d (attempt %d); awaiting cluster spec",
             self.job_name, self.index, self.host, self.port, self.attempt,
         )
+        # Heartbeat from the moment we are registered (the reference starts
+        # its heartbeat right after registration too) — a gang that takes a
+        # while to assemble must not look heartbeat-dead to the AM.
+        hb = threading.Thread(target=self._heartbeat_loop, daemon=True, name="heartbeat")
+        hb.start()
         identity = self.await_cluster_spec()
         env = self.runtime.build_env(identity, self.config)
         env["TONY_APP_ID"] = os.environ.get("TONY_APP_ID", "")
@@ -170,8 +177,6 @@ class TaskExecutor:
         log.info("starting user process: %s (cwd=%s)", command, cwd or ".")
         self._child = run_logged(command, env=env, cwd=cwd)
 
-        hb = threading.Thread(target=self._heartbeat_loop, daemon=True, name="heartbeat")
-        hb.start()
         mt = threading.Thread(target=self._metrics_loop, daemon=True, name="metrics")
         mt.start()
 

@@ -74,9 +74,14 @@ struct Loader {
   std::thread worker;
 
   ~Loader() {
-    stop.store(true);
-    cv_produce.notify_all();
-    cv_consume.notify_all();
+    {
+      // stop must flip under mu: a waiter that has evaluated its predicate
+      // but not yet blocked would otherwise miss the notify forever.
+      std::lock_guard<std::mutex> lock(mu);
+      stop.store(true);
+      cv_produce.notify_all();
+      cv_consume.notify_all();
+    }
     if (worker.joinable()) worker.join();
     if (data != nullptr) munmap(const_cast<int32_t*>(data), file_bytes);
     if (fd >= 0) close(fd);
@@ -144,6 +149,7 @@ void* tl_open(const char* path, long seq_len, long batch, long n_shards,
   L->seq_len = seq_len;
   L->batch = batch;
   L->n_shards = n_shards > 0 ? n_shards : 1;
+  if (shard_id < 0 || shard_id >= L->n_shards) { delete L; return nullptr; }
   L->shard_id = shard_id;
   L->seed = seed;
   L->window = static_cast<size_t>(seq_len) + 1;
@@ -192,12 +198,19 @@ long tl_next(void* h, int32_t* out) {
   {
     std::unique_lock<std::mutex> lock(L->mu);
     L->cv_consume.wait(lock, [&] { return L->stop.load() || L->ready[slot].load(); });
+    if (L->stop.load()) return -1;
   }
-  if (L->stop.load()) return -1;
+  // The producer never touches a slot while ready[slot] is true, so the copy
+  // can run unlocked; the hand-back (ready=false) must happen under mu so the
+  // producer's predicate check and our notify can't interleave into a lost
+  // wakeup that parks the prefetch thread forever.
   std::memcpy(out, L->ring[slot].data(), L->batch * L->window * sizeof(int32_t));
-  L->ready[slot].store(false);
-  L->tail += 1;
-  L->cv_produce.notify_one();
+  {
+    std::lock_guard<std::mutex> lock(L->mu);
+    L->ready[slot].store(false);
+    L->tail += 1;
+    L->cv_produce.notify_one();
+  }
   return 0;
 }
 

@@ -205,8 +205,15 @@ def serve_portal(apps_root: str, port: int = 0, host: str = "127.0.0.1"):
 
 
 def main() -> None:
+    from tony_tpu.config.config import TonyConfig
+    from tony_tpu.config.keys import Keys
+
     p = argparse.ArgumentParser(description="tony-tpu job-history portal")
-    p.add_argument("--port", type=int, default=8080)
+    p.add_argument(
+        "--port", type=int,
+        default=TonyConfig(read_env=True).get_int(Keys.PORTAL_PORT, 8080),
+        help="defaults to the portal.port config key",
+    )
     p.add_argument("--apps-root", default=default_apps_root())
     p.add_argument(
         "--host", default="127.0.0.1",

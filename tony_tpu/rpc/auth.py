@@ -11,6 +11,7 @@ metadata. Gated by ``application.security.enabled`` just like the reference.
 
 from __future__ import annotations
 
+import hmac
 import os
 import secrets
 
@@ -51,7 +52,7 @@ class TokenServerInterceptor(grpc.ServerInterceptor):
 
     def intercept_service(self, continuation, handler_call_details):
         meta = dict(handler_call_details.invocation_metadata or ())
-        if meta.get(_HEADER) == self._token:
+        if hmac.compare_digest(meta.get(_HEADER, ""), self._token):
             return continuation(handler_call_details)
         return self._deny
 

@@ -184,9 +184,12 @@ class ApplicationRpcClient:
             ),
         )
 
-    def get_cluster_spec(self, job_name: str, index: int) -> pb.GetClusterSpecResponse:
+    def get_cluster_spec(
+        self, job_name: str, index: int, attempt: int = 0
+    ) -> pb.GetClusterSpecResponse:
         return self._call(
-            "GetClusterSpec", pb.GetClusterSpecRequest(job_name=job_name, index=index)
+            "GetClusterSpec",
+            pb.GetClusterSpecRequest(job_name=job_name, index=index, attempt=attempt),
         )
 
     def heartbeat(self, job_name: str, index: int, attempt: int = 0) -> pb.HeartbeatResponse:

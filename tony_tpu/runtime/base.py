@@ -15,6 +15,7 @@ import json
 from dataclasses import dataclass
 
 from tony_tpu.config.config import TonyConfig
+from tony_tpu.config.keys import Keys
 
 
 @dataclass(frozen=True)
@@ -56,7 +57,7 @@ class Runtime:
 
     def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
         """Env exported into the user training process (executor-side)."""
-        return {
+        env = {
             "TONY_CLUSTER_SPEC": json.dumps(identity.cluster_spec, sort_keys=True),
             "TONY_JOB_NAME": identity.job_name,
             "TONY_TASK_INDEX": str(identity.index),
@@ -65,6 +66,27 @@ class Runtime:
             "TONY_NUM_PROCESSES": str(identity.num_processes),
             "TONY_GENERATION": str(identity.generation),
         }
+        # Checkpoint/resume glue (milestone config #5): the job config drives
+        # the trainer's checkpointing; fit() reads these as FitConfig defaults
+        # so a gang restart resumes at the last orbax step without the user
+        # script hardcoding paths.
+        ckpt_dir = config.get_str(Keys.CHECKPOINT_DIR)
+        if ckpt_dir:
+            env["TONY_CHECKPOINT_DIR"] = ckpt_dir
+            env["TONY_CHECKPOINT_INTERVAL_STEPS"] = str(
+                config.get_int(Keys.CHECKPOINT_INTERVAL_STEPS, 0)
+            )
+            env["TONY_CHECKPOINT_KEEP"] = str(config.get_int(Keys.CHECKPOINT_KEEP, 3))
+            env["TONY_RESUME_FROM_CHECKPOINT"] = (
+                "true" if config.get_bool(Keys.RESTART_RESUME_FROM_CHECKPOINT, True)
+                else "false"
+            )
+        # One flag to get per-host traces (SURVEY.md section 5 "Tracing"):
+        # the profiler server must live in the process doing the compute, so
+        # the executor exports the intent and fit() starts it.
+        if config.get_bool(Keys.PROFILER_ENABLED, False):
+            env["TONY_PROFILER_PORT"] = str(config.get_int(Keys.PROFILER_PORT, 9999))
+        return env
 
     def needs_data_port(self) -> bool:
         """Whether each task must reserve a data port for the cluster spec.

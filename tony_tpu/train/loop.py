@@ -13,6 +13,7 @@ metrics. A complete distributed trainer is:
 from __future__ import annotations
 
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable
@@ -46,11 +47,34 @@ class FitConfig:
     rules: Rules = field(default_factory=lambda: dict(DEFAULT_RULES))
     # hook called every log_every steps with a metrics dict (obs -> AM push)
     on_metrics: Callable[[dict], None] | None = None
+    resume: bool = True  # restore from checkpoint_dir if a checkpoint exists
+
+    def apply_job_env(self) -> None:
+        """Fill unset checkpoint fields from the TONY_CHECKPOINT_* env the
+        executor exported (the checkpoint.dir / checkpoint.interval_steps /
+        restart.resume_from_checkpoint job-config glue)."""
+        if not self.checkpoint_dir and os.environ.get("TONY_CHECKPOINT_DIR"):
+            self.checkpoint_dir = os.environ["TONY_CHECKPOINT_DIR"]
+            if self.checkpoint_every == 0:
+                self.checkpoint_every = int(
+                    os.environ.get("TONY_CHECKPOINT_INTERVAL_STEPS", "0")
+                )
+            self.checkpoint_keep = int(
+                os.environ.get("TONY_CHECKPOINT_KEEP", str(self.checkpoint_keep))
+            )
+            self.resume = os.environ.get("TONY_RESUME_FROM_CHECKPOINT", "true") == "true"
 
 
 def fit(cfg: FitConfig) -> dict:
     """Run the training loop to cfg.steps; returns final metrics."""
     jax_tpu.initialize()  # no-op outside a tony-tpu job
+    cfg.apply_job_env()
+    if os.environ.get("TONY_PROFILER_PORT"):
+        from tony_tpu.obs.profiler import start_server
+
+        # one server per process; offset by rank so co-hosted processes
+        # (the local backend) don't collide on the port
+        start_server(int(os.environ["TONY_PROFILER_PORT"]) + jax_tpu.process_id())
     reporter = None
     on_metrics = cfg.on_metrics
     if on_metrics is None and jax_tpu.in_tony_job():
@@ -84,10 +108,11 @@ def fit(cfg: FitConfig) -> dict:
             keep=cfg.checkpoint_keep,
             save_interval_steps=cfg.checkpoint_every,
         )
-        state, restored = manager.restore(state)
-        if restored >= 0:
-            start_step = restored
-            log.info("resumed from checkpoint step %d", restored)
+        if cfg.resume:
+            state, restored = manager.restore(state)
+            if restored >= 0:
+                start_step = restored
+                log.info("resumed from checkpoint step %d", restored)
 
     batch_sharding = NamedSharding(mesh, spec_for(("batch", "seq"), cfg.rules))
     batches = make_batches(cfg.data, batch_sharding, start_step=start_step)

@@ -97,8 +97,9 @@ class NativeTokenLoader:
         )
         if not self._handle:
             raise ValueError(
-                f"tl_open failed for {path!r} (missing file or too few windows "
-                f"for batch={batch} x shards={n_shards})"
+                f"tl_open failed for {path!r} (missing file, too few windows "
+                f"for batch={batch} x shards={n_shards}, or shard_id "
+                f"{shard_id} outside [0, {n_shards}))"
             )
         self.batch = batch
         self.window = seq_len + 1
