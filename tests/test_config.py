@@ -134,3 +134,31 @@ def test_job_suffixes_match_taskspec_fields():
 
     fields = {f.name for f in dataclasses.fields(TaskTypeSpec)} - {"name"}
     assert fields == set(JOB_SUFFIXES)
+
+
+def test_no_dead_config_keys():
+    """Every advertised Keys.* constant must have a consumer outside
+    keys.py — a config surface that silently ignores documented keys is
+    worse than a smaller honest one."""
+    import re
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    names = []
+    for line in open(os.path.join(repo, "tony_tpu", "config", "keys.py")):
+        m = re.match(r'\s+([A-Z_]+) = "', line)
+        if m:
+            names.append(m.group(1))
+    assert len(names) > 25  # sanity: the registry is still the registry
+    out = subprocess.run(
+        ["grep", "-rn", "--include=*.py", "-E", r"Keys\.[A-Z_]+",
+         os.path.join(repo, "tony_tpu"), os.path.join(repo, "tests")],
+        capture_output=True, text=True,
+    ).stdout
+    dead = [
+        n for n in names
+        if not any(
+            f"Keys.{n}" in l for l in out.splitlines() if "config/keys.py" not in l
+        )
+    ]
+    assert dead == [], f"config keys defined but consumed nowhere: {dead}"

@@ -44,23 +44,51 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # what the per-layer jax.checkpoint keeps: 'nothing' recomputes the whole
+    # layer in bwd (min HBM); 'dots' saves matmul outputs with no batch dims
+    # (nothing in practice here — all our dots carry batch); 'checkpoint_dots'
+    # saves every matmul output (min recompute, max HBM)
+    remat_policy: str = "nothing"
     # 'dot' = fused plain attention; 'flash' = pallas kernel (tony_tpu.ops);
     # 'ring' = sequence-parallel ring attention (tony_tpu.parallel).
     attention_impl: str = "dot"
+    # MoE variant (n_experts > 0): every layer's FFN becomes a GShard-style
+    # top-k expert block (tony_tpu.parallel.moe) with the expert dim on the
+    # mesh's ``ep`` axis; aux load-balancing loss is added to the objective.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
     @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    @property
     def n_params(self) -> int:
         """Exact parameter count (embeddings included, tied=False)."""
         d, h = self.dim, self.head_dim
         attn = d * self.n_heads * h + 2 * d * self.n_kv_heads * h + self.n_heads * h * d
-        ffn = 3 * d * self.ffn_dim
+        if self.is_moe:
+            ffn = d * self.n_experts + 3 * self.n_experts * d * self.ffn_dim
+        else:
+            ffn = 3 * d * self.ffn_dim
         norms = 2 * d
         per_layer = attn + ffn + norms
         return self.vocab_size * d * 2 + self.n_layers * per_layer + d
+
+    @property
+    def n_active_params(self) -> int:
+        """Parameters touched per token (MoE: only top_k experts fire) —
+        the right N for 6*N FLOPs accounting."""
+        if not self.is_moe:
+            return self.n_params
+        inactive = 3 * (self.n_experts - self.moe_top_k) * self.dim * self.ffn_dim
+        return self.n_params - self.n_layers * inactive
 
     # --- presets -----------------------------------------------------------
 
@@ -115,6 +143,19 @@ class LlamaConfig:
             ffn_dim=128, max_seq_len=64, **kw,
         )
 
+    @classmethod
+    def tiny_moe(cls, **kw: Any) -> "LlamaConfig":
+        """Test-size MoE config (CPU-fast, 4 experts top-2)."""
+        kw.setdefault("n_experts", 4)
+        return cls.tiny(**kw)
+
+    @classmethod
+    def bench_moe(cls, **kw: Any) -> "LlamaConfig":
+        """Single-chip MoE benchmark: 8 experts top-2 on the 410M trunk
+        (~2.1B total params, ~700M active)."""
+        kw.setdefault("n_experts", 8)
+        return cls.bench_410m(**kw)
+
 
 # --- parameter tree -----------------------------------------------------------
 
@@ -126,6 +167,19 @@ def logical_axes(cfg: LlamaConfig) -> Params:
     ``tp``, model dim on ``fsdp``; the leading stacked-layer dim is never
     sharded. tony_tpu.parallel.sharding turns these into NamedShardings.
     """
+    if cfg.is_moe:
+        ffn_axes = {
+            "router": ("layers", "embed", "expert"),
+            "w1": ("layers", "expert", "embed", "ffn"),
+            "w3": ("layers", "expert", "embed", "ffn"),
+            "w2": ("layers", "expert", "ffn", "embed"),
+        }
+    else:
+        ffn_axes = {
+            "w1": ("layers", "embed", "ffn"),
+            "w3": ("layers", "embed", "ffn"),
+            "w2": ("layers", "ffn", "embed"),
+        }
     return {
         "tok_emb": ("vocab", "embed"),
         "layers": {
@@ -135,9 +189,7 @@ def logical_axes(cfg: LlamaConfig) -> Params:
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
             "ffn_norm": ("layers", "norm"),
-            "w1": ("layers", "embed", "ffn"),
-            "w3": ("layers", "embed", "ffn"),
-            "w2": ("layers", "ffn", "embed"),
+            **ffn_axes,
         },
         "final_norm": ("norm",),
         "lm_head": ("embed", "vocab"),
@@ -154,6 +206,21 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
         scale = 1.0 / math.sqrt(fan_in)
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
 
+    F, E = cfg.ffn_dim, cfg.n_experts
+    if cfg.is_moe:
+        ffn = {
+            # routing statistics stay float32 (see parallel.moe)
+            "router": dense(keys[5], (L, d, E), d).astype(jnp.float32),
+            "w1": dense(keys[6], (L, E, d, F), d),
+            "w3": dense(keys[7], (L, E, d, F), d),
+            "w2": dense(jax.random.split(keys[8])[0], (L, E, F, d), F),
+        }
+    else:
+        ffn = {
+            "w1": dense(keys[5], (L, d, F), d),
+            "w3": dense(keys[6], (L, d, F), d),
+            "w2": dense(keys[7], (L, F, d), F),
+        }
     return {
         "tok_emb": dense(keys[0], (cfg.vocab_size, d), d),
         "layers": {
@@ -163,9 +230,7 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
             "wv": dense(keys[3], (L, d, nkv), d),
             "wo": dense(keys[4], (L, nq, d), nq),
             "ffn_norm": jnp.ones((L, d), cfg.dtype),
-            "w1": dense(keys[5], (L, d, cfg.ffn_dim), d),
-            "w3": dense(keys[6], (L, d, cfg.ffn_dim), d),
-            "w2": dense(keys[7], (L, cfg.ffn_dim, d), cfg.ffn_dim),
+            **ffn,
         },
         "final_norm": jnp.ones((d,), cfg.dtype),
         "lm_head": dense(keys[8], (d, cfg.vocab_size), d),
@@ -243,33 +308,93 @@ def attention_block(x: jax.Array, lp: Params, cfg: LlamaConfig,
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     out = _get_attention(cfg)(q, k, v, cfg)
+    # named save point: remat_policy='save_attn' keeps this activation so the
+    # bwd recompute skips qkv projections + the attention kernel (~29% of a
+    # layer's fwd FLOPs) for ~32MB/layer at bench shapes
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "attn_out")
     return out.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
 
 
 def ffn_block(x: jax.Array, lp: Params) -> jax.Array:
-    return (jax.nn.silu(x @ lp["w1"]) * (x @ lp["w3"])) @ lp["w2"]
+    from jax.ad_checkpoint import checkpoint_name
+
+    # named save point: remat policies can keep the gate product so the bwd
+    # recompute skips the two widest matmuls (w1/w3, ~45% of a layer's fwd)
+    gate = checkpoint_name(jax.nn.silu(x @ lp["w1"]) * (x @ lp["w3"]), "ffn_gate")
+    return gate @ lp["w2"]
+
+
+def moe_ffn_block(x: jax.Array, lp: Params, cfg: LlamaConfig):
+    """Expert-parallel FFN: (y, aux_loss). See tony_tpu.parallel.moe."""
+    from tony_tpu.parallel.moe import MoEConfig, moe_block
+
+    mcfg = MoEConfig(
+        dim=cfg.dim, ffn_dim=cfg.ffn_dim, n_experts=cfg.n_experts,
+        top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+    )
+    return moe_block(
+        {"router": lp["router"], "w1": lp["w1"], "w3": lp["w3"], "w2": lp["w2"]},
+        x, mcfg,
+    )
 
 
 # --- forward ------------------------------------------------------------------
 
 
-def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+def transformer_block(x: jax.Array, lp: Params, cfg: LlamaConfig,
+                      cos: jax.Array, sin: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """One decoder layer: (x, lp) -> (x', aux_loss). aux is 0 for dense."""
+    h = x + attention_block(
+        rms_norm(x, lp["attn_norm"], cfg.norm_eps), lp, cfg, cos, sin
+    )
+    normed = rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
+    if cfg.is_moe:
+        delta, aux = moe_ffn_block(normed, lp, cfg)
+    else:
+        delta, aux = ffn_block(normed, lp), jnp.zeros((), jnp.float32)
+    return h + delta, aux
+
+
+def _remat_policy(name: str):
+    policies = {
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "save_attn": jax.checkpoint_policies.save_only_these_names("attn_out"),
+        "save_gate": jax.checkpoint_policies.save_only_these_names("ffn_gate"),
+        "save_attn_gate": jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "ffn_gate"
+        ),
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "checkpoint_dots": jax.checkpoint_policies.checkpoint_dots,
+    }
+    if name not in policies:
+        raise ValueError(f"unknown remat_policy {name!r} (expected {sorted(policies)})")
+    return policies[name]
+
+
+def forward_with_aux(
+    params: Params, tokens: jax.Array, cfg: LlamaConfig
+) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, S] int32 -> (logits [B, S, vocab] float32, aux_loss)."""
     x = params["tok_emb"][tokens]
     cos, sin = rope_table(cfg, tokens.shape[1])
 
-    def block(x: jax.Array, lp: Params) -> tuple[jax.Array, None]:
-        h = x + attention_block(
-            rms_norm(x, lp["attn_norm"], cfg.norm_eps), lp, cfg, cos, sin
-        )
-        out = h + ffn_block(rms_norm(h, lp["ffn_norm"], cfg.norm_eps), lp)
-        return out, None
+    def block(carry, lp: Params):
+        x, aux_acc = carry
+        out, aux = transformer_block(x, lp, cfg, cos, sin)
+        return (out, aux_acc + aux), None
 
     if cfg.remat:
-        block = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
-    x, _ = lax.scan(block, x, params["layers"])
+        block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))
+    (x, aux), _ = lax.scan(block, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return (x @ params["lm_head"]).astype(jnp.float32), aux / cfg.n_layers
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+    return forward_with_aux(params, tokens, cfg)[0]
 
 
 def loss_from_pairs(
@@ -281,12 +406,15 @@ def loss_from_pairs(
     activations, and targets, so a ``sp``-sharded seq axis stays aligned end
     to end (no off-by-one reshard between forward and loss).
     """
-    logits = forward(params, inputs, cfg)
+    logits, aux = forward_with_aux(params, inputs, cfg)
     # logsumexp - target_logit == -log_softmax[target], without materialising
     # the full [B,S,V] log-prob tensor (half the HBM traffic of the loss).
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - tgt)
+    ce = jnp.mean(lse - tgt)
+    if cfg.is_moe:
+        ce = ce + cfg.moe_aux_coef * aux
+    return ce
 
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
@@ -295,14 +423,15 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
 
 
 def train_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
-    """Approximate training FLOPs per token: 6*N (param matmuls, fwd+bwd)
-    plus the causal-attention score/value matmuls (12*L*D*S/2)."""
-    return 6.0 * cfg.n_params + 6.0 * cfg.n_layers * cfg.dim * seq_len
+    """Approximate training FLOPs per token: 6*N_active (param matmuls,
+    fwd+bwd; MoE counts only the top-k experts that fire per token) plus the
+    causal-attention score/value matmuls (12*L*D*S/2)."""
+    return 6.0 * cfg.n_active_params + 6.0 * cfg.n_layers * cfg.dim * seq_len
 
 
 __all__ = [
-    "LlamaConfig", "init_params", "logical_axes", "forward", "loss_fn",
-    "loss_from_pairs",
+    "LlamaConfig", "init_params", "logical_axes", "forward",
+    "forward_with_aux", "loss_fn", "loss_from_pairs",
     "rms_norm", "rope_table", "apply_rope", "dot_attention",
-    "train_flops_per_token",
+    "transformer_block", "train_flops_per_token",
 ]

@@ -5,15 +5,20 @@ The reference delegates all parallelism to user frameworks (SURVEY.md section 2
 implements none itself). Here the mesh is first-class: axes
 
 - ``dp``   -- pure data parallel (params replicated, grads psum'd)
+- ``pp``   -- pipeline parallel (layer stages, GPipe microbatch schedule)
 - ``fsdp`` -- data parallel with parameter/optimizer sharding (ZeRO-style)
+- ``ep``   -- expert parallel (MoE expert dim; doubles as a batch axis)
 - ``tp``   -- tensor (Megatron-style) parallel over heads / ffn hidden
 - ``sp``   -- sequence/context parallel (ring attention over lax.ppermute)
 
 Collectives over these axes ride ICI within a slice; a multi-slice job maps its
 slice-crossing axis (usually ``dp``) onto DCN by putting it outermost, which is
 what ``mesh_utils.create_device_mesh`` produces for contiguous device order.
-Pipeline (``pp``) and expert (``ep``) axes are provided by
-tony_tpu.parallel.pipeline / .moe on top of the same mesh.
+``pp`` sits next (stage hops are one point-to-point ppermute per tick —
+latency-tolerant); bandwidth-hungry fsdp/ep/tp/sp stay innermost on the
+shortest ICI paths. The GPipe schedule lives in tony_tpu.parallel.pipeline,
+the expert dispatch in tony_tpu.parallel.moe; both are reachable from the
+trainer via this mesh (LlamaConfig n_experts / FitConfig mesh_shape.pp).
 """
 
 from __future__ import annotations
@@ -27,7 +32,7 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 # Canonical axis order: slice-crossing / outermost first.
-MESH_AXES = ("dp", "fsdp", "tp", "sp")
+MESH_AXES = ("dp", "pp", "fsdp", "ep", "tp", "sp")
 
 
 @dataclass(frozen=True)
@@ -35,13 +40,15 @@ class MeshShape:
     """Per-axis sizes. Product must equal the number of devices used."""
 
     dp: int = 1
+    pp: int = 1
     fsdp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
     @property
-    def sizes(self) -> tuple[int, int, int, int]:
-        return (self.dp, self.fsdp, self.tp, self.sp)
+    def sizes(self) -> tuple[int, ...]:
+        return (self.dp, self.pp, self.fsdp, self.ep, self.tp, self.sp)
 
     @property
     def n_devices(self) -> int:
@@ -146,7 +153,7 @@ def build_multislice_mesh(
             f"got {len(devices)}"
         )
     ici_shape = per_slice.sizes
-    dcn_shape = (n_slices, 1, 1, 1)
+    dcn_shape = (n_slices,) + (1,) * (len(MESH_AXES) - 1)
     try:
         dev_array = mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape, devices=devices

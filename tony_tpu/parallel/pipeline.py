@@ -51,8 +51,14 @@ def pipeline_local(
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def probe_out():
-        """Output structure for one microbatch (to size the buffers)."""
-        return jax.eval_shape(lambda p, b: stage_fn(p, b), stage_params, x[0])
+        """Output structure for one microbatch (to size the buffers).
+
+        The probe input must carry the same varying-axes type as the real
+        per-tick input (pp-varying): a stage_fn that scans over pp-sharded
+        layer params would otherwise fail vma typing at trace time.
+        """
+        xin = jax.tree.map(lambda a: lax.pcast(a, (axis_name,), to="varying"), x[0])
+        return jax.eval_shape(lambda p, b: stage_fn(p, b), stage_params, xin)
 
     out_shape = probe_out()
     # pcast marks the zero buffers as device-varying along the pipeline axis
@@ -69,7 +75,10 @@ def pipeline_local(
         recv, out = carry
         feed_idx = jnp.clip(t, 0, M - 1)
         first_stage_in = lax.dynamic_index_in_dim(x, feed_idx, 0, keepdims=False)
-        cur = jnp.where(my == 0, first_stage_in.astype(recv.dtype), recv)
+        first_stage_in = lax.pcast(
+            first_stage_in.astype(recv.dtype), (axis_name,), to="varying"
+        )
+        cur = jnp.where(my == 0, first_stage_in, recv)
         y = stage_fn(stage_params, cur)
         out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
         updated = lax.dynamic_update_index_in_dim(out, y, out_idx, 0)

@@ -20,15 +20,20 @@ Rules = Mapping[str, str | tuple[str, ...] | None]
 #   - "embed"  (model dim)        sharded over fsdp  (ZeRO-style param shard)
 #   - "heads"/"ffn" (wide dims)   sharded over tp
 #   - "vocab"  sharded over tp    (output projection column-parallel)
-#   - "batch"  over dp+fsdp, "seq" over sp (activations)
+#   - "batch"  over dp+fsdp+ep, "seq" over sp (activations)
+#   - "expert" over ep            (GShard: the dispatch/combine einsums lower
+#                                  to the expert all-to-all; ep doubles as a
+#                                  batch axis for the non-expert layers)
+#   - "layers" replicated; the PP train step overrides it to "pp" (stage dim)
 DEFAULT_RULES: Rules = {
-    "batch": ("dp", "fsdp"),
+    "batch": ("dp", "fsdp", "ep"),
     "seq": "sp",
     "embed": "fsdp",
     "heads": "tp",
     "kv_heads": "tp",
     "ffn": "tp",
     "vocab": "tp",
+    "expert": "ep",
     "layers": None,
     "head_dim": None,
     "norm": None,
@@ -64,7 +69,7 @@ def attn_spec(mesh: Mesh, seq_axis: str | None = None) -> P:
     Shared by every AttnFn wrapper so the sharding policy lives in one place.
     """
     axes = set(mesh.axis_names)
-    batch = tuple(a for a in ("dp", "fsdp") if a in axes) or None
+    batch = tuple(a for a in ("dp", "fsdp", "ep") if a in axes) or None
     heads = "tp" if "tp" in axes else None
     seq = seq_axis if seq_axis in axes else None
     return P(batch, seq, heads, None)

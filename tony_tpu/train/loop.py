@@ -45,6 +45,8 @@ class FitConfig:
     lr: float = 3e-4
     warmup_steps: int = 100
     rules: Rules = field(default_factory=lambda: dict(DEFAULT_RULES))
+    # GPipe microbatches when mesh_shape.pp > 1 (0 -> 2 per stage)
+    pp_microbatches: int = 0
     # hook called every log_every steps with a metrics dict (obs -> AM push)
     on_metrics: Callable[[dict], None] | None = None
     resume: bool = True  # restore from checkpoint_dir if a checkpoint exists
@@ -95,8 +97,15 @@ def fit(cfg: FitConfig) -> dict:
     optimizer = default_optimizer(
         lr=cfg.lr, warmup_steps=cfg.warmup_steps, decay_steps=max(cfg.steps, cfg.warmup_steps + 1)
     )
-    state = make_train_state(jax.random.key(0), cfg.model, mesh, optimizer, cfg.rules)
-    step_fn = make_train_step(cfg.model, mesh, optimizer, cfg.rules)
+    rules = cfg.rules
+    if int(mesh.shape.get("pp", 1)) > 1:
+        from tony_tpu.train.trainer import pp_rules
+
+        rules = pp_rules(rules)
+    state = make_train_state(jax.random.key(0), cfg.model, mesh, optimizer, rules)
+    step_fn = make_train_step(
+        cfg.model, mesh, optimizer, rules, n_microbatches=cfg.pp_microbatches
+    )
 
     manager = None
     start_step = 0

@@ -112,6 +112,7 @@ def make_train_state(
 def make_train_step(
     cfg: llama.LlamaConfig, mesh: Mesh,
     optimizer: optax.GradientTransformation, rules: Rules = DEFAULT_RULES,
+    *, n_microbatches: int = 0,
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted train step:
     ``(state, inputs[B,S], targets[B,S]) -> (state, metrics)``.
@@ -120,15 +121,27 @@ def make_train_step(
     llama.loss_from_pairs) so the seq axis shards cleanly over ``sp``.
     Gradients are computed in the params' dtype (Adam's first moment is kept
     fp32 via mu_dtype); donation avoids a second copy of state.
+
+    A mesh with ``pp > 1`` selects the GPipe pipeline loss (layer stages over
+    the ``pp`` axis, ``n_microbatches`` microbatches — default 2 per stage);
+    the caller's rules must map "layers" to "pp" (fit() does this
+    automatically; :func:`pp_rules` applies the override).
     """
+    pp = int(mesh.shape.get("pp", 1))
+    if pp > 1:
+        rules = pp_rules(rules)
+        loss_fn = partial(
+            pp_loss_from_pairs, cfg=cfg, mesh=mesh,
+            n_microbatches=n_microbatches or 2 * pp,
+        )
+    else:
+        loss_fn = partial(llama.loss_from_pairs, cfg=cfg)
     shardings = state_shardings(cfg, mesh, optimizer, rules)
     batch_sharding = NamedSharding(mesh, spec_for(("batch", "seq"), rules))
     replicated = NamedSharding(mesh, P())
 
     def step(state: TrainState, inputs: jax.Array, targets: jax.Array):
-        loss, grads = jax.value_and_grad(llama.loss_from_pairs)(
-            state.params, inputs, targets, cfg
-        )
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, inputs, targets)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
@@ -141,3 +154,64 @@ def make_train_step(
         out_shardings=(shardings, replicated),
         donate_argnums=(0,),
     )
+
+
+def pp_rules(rules: Rules = DEFAULT_RULES) -> Rules:
+    """Rules for pipeline training: the stacked-layer dim becomes the stage
+    dim, sharded over ``pp`` (each stage owns n_layers/pp layers)."""
+    return {**rules, "layers": "pp"}
+
+
+def pp_loss_from_pairs(
+    params: Params, inputs: jax.Array, targets: jax.Array, *,
+    cfg: llama.LlamaConfig, mesh: Mesh, n_microbatches: int,
+) -> jax.Array:
+    """GPipe pipeline loss: embedding and head run auto-sharded outside the
+    pipeline; the layer stack runs as pp stages under a shard_map that is
+    manual over ``pp`` only (dp/fsdp/tp/sp stay XLA-auto inside the stages,
+    so the same Megatron/FSDP shardings compose with pipelining).
+
+    Reference: GPipe (arXiv:1811.06965) schedule; bubble (P-1)/(M+P-1).
+    """
+    from tony_tpu.parallel.pipeline import microbatch, pipeline_local, unmicrobatch
+
+    if cfg.is_moe:
+        raise NotImplementedError("pp + MoE composition not supported yet")
+    pp = int(mesh.shape["pp"])
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+
+    x = params["tok_emb"][inputs]
+    cos, sin = llama.rope_table(cfg, inputs.shape[1])
+    xs = microbatch(x, n_microbatches)  # [M, mb, S, D]
+
+    def body(stage_layers: Params, xs_: jax.Array, cos_: jax.Array, sin_: jax.Array):
+        def stage_fn(lp_stack: Params, mb: jax.Array) -> jax.Array:
+            def blk(h: jax.Array, lp: Params):
+                out, _ = llama.transformer_block(h, lp, cfg, cos_, sin_)
+                return out, None
+
+            if cfg.remat:
+                blk = jax.checkpoint(
+                    blk, policy=jax.checkpoint_policies.nothing_saveable
+                )
+            y, _ = jax.lax.scan(blk, mb, lp_stack)
+            return y
+
+        return pipeline_local(stage_fn, stage_layers, xs_, axis_name="pp")
+
+    layer_specs = jax.tree.map(lambda _: P("pp"), params["layers"])
+    h = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P(), P()),
+        out_specs=P(),
+        axis_names={"pp"},  # manual over pp; all other axes stay auto
+    )(params["layers"], xs, cos, sin)
+    h = unmicrobatch(h)
+
+    h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)
