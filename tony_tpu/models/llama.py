@@ -50,8 +50,15 @@ class LlamaConfig:
     # saves every matmul output (min recompute, max HBM)
     remat_policy: str = "nothing"
     # 'dot' = fused plain attention; 'flash' = pallas kernel (tony_tpu.ops);
-    # 'ring' = sequence-parallel ring attention (tony_tpu.parallel).
+    # 'ring' = sequence-parallel ring attention (tony_tpu.parallel);
+    # 'ulysses' = all-to-all head-sharded sequence parallelism.
     attention_impl: str = "dot"
+    # pallas flash kernel tile sizes (attention_impl='flash'); clipped to S
+    flash_block_q: int = 512
+    flash_block_k: int = 1024
+    # lax.scan unroll factor for the layer stack (trades compile time /
+    # code size for cross-layer scheduling freedom)
+    scan_unroll: int = 1
     # MoE variant (n_experts > 0): every layer's FFN becomes a GShard-style
     # top-k expert block (tony_tpu.parallel.moe) with the expert dim on the
     # mesh's ``ep`` axis; aux load-balancing loss is added to the objective.
@@ -200,7 +207,7 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
     """Initialise the parameter pytree (per-layer arrays stacked on axis 0)."""
     d, hd = cfg.dim, cfg.head_dim
     nq, nkv, L = cfg.n_heads * hd, cfg.n_kv_heads * hd, cfg.n_layers
-    keys = jax.random.split(rng, 9)
+    keys = jax.random.split(rng, 10)
 
     def dense(key: jax.Array, shape: tuple[int, ...], fan_in: int) -> jax.Array:
         scale = 1.0 / math.sqrt(fan_in)
@@ -213,7 +220,7 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
             "router": dense(keys[5], (L, d, E), d).astype(jnp.float32),
             "w1": dense(keys[6], (L, E, d, F), d),
             "w3": dense(keys[7], (L, E, d, F), d),
-            "w2": dense(jax.random.split(keys[8])[0], (L, E, F, d), F),
+            "w2": dense(keys[9], (L, E, F, d), F),
         }
     else:
         ffn = {
@@ -303,7 +310,10 @@ def attention_block(x: jax.Array, lp: Params, cfg: LlamaConfig,
     v = (x @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if cfg.n_kv_heads != cfg.n_heads:  # GQA: expand kv heads to query heads
+    # GQA: the flash kernel reads each kv head n_heads/n_kv_heads times via
+    # its BlockSpec index map — no HBM-materialised repeat. Other impls get
+    # the expanded kv tensors.
+    if cfg.n_kv_heads != cfg.n_heads and cfg.attention_impl != "flash":
         rep = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
@@ -387,7 +397,10 @@ def forward_with_aux(
 
     if cfg.remat:
         block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))
-    (x, aux), _ = lax.scan(block, (x, jnp.zeros((), jnp.float32)), params["layers"])
+    (x, aux), _ = lax.scan(
+        block, (x, jnp.zeros((), jnp.float32)), params["layers"],
+        unroll=cfg.scan_unroll,
+    )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32), aux / cfg.n_layers
 

@@ -81,12 +81,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
         lse_ref[0, 0] = m_sc[:, 0] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, *, scale, blk_q, blk_k, causal):
-    """q/k/v: [BH, S, D] -> (out [BH, S, D], lse [BH, 1, S] fp32)."""
+def _kv_index(b: int, heads: int, kv_heads: int) -> int:
+    """Fold a [B*H] grid index onto the [B*kv_heads] K/V array (GQA)."""
+    rep = heads // kv_heads
+    return (b // heads) * kv_heads + (b % heads) // rep
+
+
+def _flash_fwd(q, k, v, *, scale, blk_q, blk_k, causal, heads, kv_heads):
+    """q: [B*heads, S, D], k/v: [B*kv_heads, S, D] ->
+    (out [B*heads, S, D], lse [B*heads, 1, S] fp32)."""
     BH, S, D = q.shape
     nq, nk = pl.cdiv(S, blk_q), pl.cdiv(S, blk_k)
     qspec = pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0))
-    kspec = pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0))
+    kspec = pl.BlockSpec(
+        (1, blk_k, D), lambda b, i, j: (_kv_index(b, heads, kv_heads), j, 0)
+    )
     rowspec = pl.BlockSpec((1, 1, blk_q), lambda b, i, j: (b, 0, i))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal),
@@ -97,6 +106,10 @@ def _flash_fwd(q, k, v, *, scale, blk_q, blk_k, causal):
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ],
+        # out/lse blocks revisit the same index across the k-step dim
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         scratch_shapes=[
             pltpu.VMEM((blk_q, D), jnp.float32),
             pltpu.VMEM((blk_q, 1), jnp.float32),
@@ -150,17 +163,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    dk_acc, dv_acc, *, scale, blk_q, blk_k, causal):
-    # grid: (BH, k-block j, q-block i) — q innermost, accumulate dk/dv
+                    dk_acc, dv_acc, *, scale, blk_q, blk_k, causal, nq):
+    # grid: (B*kv_heads, k-block j, rep*q-blocks i) — innermost dim walks all
+    # q blocks of every query head sharing this kv head (GQA), accumulating
+    # dk/dv across the group; i % nq is the q-block position within one head.
     j, i = pl.program_id(1), pl.program_id(2)
     ni = pl.num_programs(2)
+    iq = i % nq
 
     @pl.when(i == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = (not causal) or (j * blk_k <= i * blk_q + blk_q - 1)
+    run = (not causal) or (j * blk_k <= iq * blk_q + blk_q - 1)
 
     @pl.when(run)
     def _block():
@@ -171,7 +187,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            q_pos = i * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            q_pos = iq * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
             k_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG)
         p = jnp.exp(s - lse[:, None])                       # [bq, bk]
@@ -194,16 +210,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal):
+def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal, heads, kv_heads):
     q, k, v, out, lse = res
     BH, S, D = q.shape
+    BKV = k.shape[0]
+    rep = heads // kv_heads
     nq, nk = pl.cdiv(S, blk_q), pl.cdiv(S, blk_k)
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[:, None, :]  # [BH, 1, S]
 
     qspec = pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0))
-    kspec = pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0))
+    kspec = pl.BlockSpec(
+        (1, blk_k, D), lambda b, i, j: (_kv_index(b, heads, kv_heads), j, 0)
+    )
     rowspec = pl.BlockSpec((1, 1, blk_q), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
@@ -213,26 +233,40 @@ def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal):
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)],
         scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=_use_interpret(),
     )(q, k, v, g, lse, delta)[0]
 
-    # swap the two inner grid dims: k-block outer, q-block inner
-    qspec_t = pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0))
+    # dk/dv pass: grid over the [B*kv_heads] K/V array; k-block outer, then
+    # the inner dim walks rep*nq q-blocks (all query heads of the GQA group
+    # back-to-back) so dk/dv accumulate in VMEM scratch across the group.
+    def _q_index(b: int, i: int) -> int:
+        return (b // kv_heads) * heads + (b % kv_heads) * rep + i // nq
+
+    qspec_t = pl.BlockSpec((1, blk_q, D), lambda b, j, i: (_q_index(b, i), i % nq, 0))
     kspec_t = pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0))
-    rowspec_t = pl.BlockSpec((1, 1, blk_q), lambda b, j, i: (b, 0, i))
+    rowspec_t = pl.BlockSpec((1, 1, blk_q), lambda b, j, i: (_q_index(b, i), 0, i % nq))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal),
-        grid=(BH, nk, nq),
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k,
+            causal=causal, nq=nq,
+        ),
+        grid=(BKV, nk, rep * nq),
         in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t],
         out_specs=[kspec_t, kspec_t],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            jax.ShapeDtypeStruct((BKV, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BKV, S, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_k, D), jnp.float32),
             pltpu.VMEM((blk_k, D), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=_use_interpret(),
     )(q, k, v, g, lse, delta)
     return dq, dk, dv
@@ -241,19 +275,22 @@ def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal):
 # --- public entry -------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, blk_q, blk_k, causal):
-    out, _ = _flash_fwd(q, k, v, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, blk_q, blk_k, causal, heads, kv_heads):
+    out, _ = _flash_fwd(q, k, v, scale=scale, blk_q=blk_q, blk_k=blk_k,
+                        causal=causal, heads=heads, kv_heads=kv_heads)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, blk_q, blk_k, causal):
-    out, lse = _flash_fwd(q, k, v, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal)
+def _flash_fwd_rule(q, k, v, scale, blk_q, blk_k, causal, heads, kv_heads):
+    out, lse = _flash_fwd(q, k, v, scale=scale, blk_q=blk_q, blk_k=blk_k,
+                          causal=causal, heads=heads, kv_heads=kv_heads)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(scale, blk_q, blk_k, causal, res, g):
-    return _flash_bwd(res, g, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal)
+def _flash_bwd_rule(scale, blk_q, blk_k, causal, heads, kv_heads, res, g):
+    return _flash_bwd(res, g, scale=scale, blk_q=blk_q, blk_k=blk_k,
+                      causal=causal, heads=heads, kv_heads=kv_heads)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -266,18 +303,28 @@ def flash_attention(
     cfg=None,
     *,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     scale: float | None = None,
 ) -> jax.Array:
     """Causal flash attention. q/k/v: [B, S, H, head_dim] -> same shape.
 
-    Matches the AttnFn contract of tony_tpu.models.llama. Sequence length
-    must be a multiple of the (possibly clipped) block sizes. The [B,S,H,D]
-    -> [B*H,S,D] fold is done here; XLA fuses the transposes into the
-    surrounding projections.
+    Matches the AttnFn contract of tony_tpu.models.llama; tile sizes come
+    from ``cfg.flash_block_q/flash_block_k`` when a config is passed (kwargs
+    win). Sequence length must be a multiple of the (possibly clipped) block
+    sizes. The [B,S,H,D] -> [B*H,S,D] fold is done here; XLA fuses the
+    transposes into the surrounding projections. K/V may carry fewer heads
+    than Q (GQA): the kernel reads each K/V head n_heads/n_kv_heads times via
+    its BlockSpec index map instead of materialising the repeat in HBM.
     """
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"n_heads {H} not a multiple of n_kv_heads {Hkv}")
+    if block_q is None:
+        block_q = getattr(cfg, "flash_block_q", None) or 512
+    if block_k is None:
+        block_k = getattr(cfg, "flash_block_k", None) or 1024
     blk_q = min(block_q, S)
     blk_k = min(block_k, S)
     if S % blk_q or S % blk_k:
@@ -286,9 +333,10 @@ def flash_attention(
         scale = 1.0 / math.sqrt(D)
 
     def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, S, D)
 
-    out = _flash(fold(q), fold(k), fold(v), scale, blk_q, blk_k, causal)
+    out = _flash(fold(q), fold(k), fold(v), scale, blk_q, blk_k, causal, H, Hkv)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
