@@ -1,0 +1,131 @@
+"""Single-chip perf sweep for the bench_1b4 train step.
+
+Runs each candidate config in a fresh subprocess (clean HBM, no allocator
+carry-over) and appends one JSON line per config to sweep_results.jsonl.
+
+Usage:
+  python scripts/perf_sweep.py            # run the default grid
+  python scripts/perf_sweep.py --one '{"remat_policy": "save_attn"}'
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+RESULTS = os.path.join(os.path.dirname(__file__), "sweep_results.jsonl")
+
+CHILD = r"""
+import json, sys, time
+cfg_kw = json.loads(sys.argv[1])
+batch = cfg_kw.pop("batch", 4)
+steps = cfg_kw.pop("steps", 10)
+seq = cfg_kw.pop("seq", 2048)
+mu_dtype = cfg_kw.pop("mu_dtype", "float32")
+preset = cfg_kw.pop("preset", "bench_1b4")
+
+import jax
+import jax.numpy as jnp
+import optax
+from tony_tpu.models.llama import LlamaConfig, train_flops_per_token
+from tony_tpu.obs.metrics import StepTimer, chip_peak_flops
+from tony_tpu.parallel.mesh import single_device_mesh
+from tony_tpu.train.trainer import make_train_state, make_train_step
+
+cfg_kw.setdefault("attention_impl", "flash")
+cfg = getattr(LlamaConfig, preset)(**cfg_kw)
+mesh = single_device_mesh()
+sched = optax.warmup_cosine_decay_schedule(0.0, 3e-4, 10, 1000)
+opt = optax.chain(
+    optax.clip_by_global_norm(1.0),
+    optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=0.1,
+                mu_dtype=getattr(jnp, mu_dtype)),
+)
+state = make_train_state(jax.random.key(0), cfg, mesh, opt)
+step = make_train_step(cfg, mesh, opt)
+tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size)
+inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+state, metrics = step(state, inputs, targets)
+state, metrics = step(state, inputs, targets)
+float(metrics["loss"])  # sync
+
+timer = StepTimer(train_flops_per_token(cfg, seq), batch * seq, 1)
+t0 = time.perf_counter()
+for _ in range(steps):
+    state, metrics = step(state, inputs, targets)
+loss = float(metrics["loss"])  # sync fence
+timer.record(time.perf_counter() - t0, steps)
+mfu = timer.mfu(chip_peak_flops())
+mem = jax.local_devices()[0].memory_stats() or {}
+print("RESULT " + json.dumps({
+    "tok_s": round(timer.tokens_per_sec_per_chip, 1),
+    "mfu": round(mfu, 4),
+    "loss": round(loss, 4),
+    "peak_hbm_gb": round(mem.get("peak_bytes_in_use", 0) / 2**30, 2),
+}))
+"""
+
+
+def run_one(cfg: dict, timeout: int = 600) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-c", CHILD, json.dumps(cfg)],
+        capture_output=True, text=True, timeout=timeout,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    rec = {"cfg": cfg}
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT "):
+            rec.update(json.loads(line[len("RESULT "):]))
+            break
+    else:
+        tail = (out.stderr or out.stdout).strip().splitlines()[-12:]
+        rec["error"] = "\n".join(tail)
+    return rec
+
+
+GRID = [
+    # baseline = round-2 shipped config
+    {"remat_policy": "nothing"},
+    # remat save-point sweep
+    {"remat_policy": "save_attn"},
+    {"remat_policy": "save_gate"},
+    {"remat_policy": "save_attn_gate"},
+    {"remat_policy": "checkpoint_dots"},
+    # no remat at all (likely OOM at B=4 -- worth knowing)
+    {"remat_policy": "nothing", "no_remat": 1},
+    # flash tile sweep at the best remat policy guess
+    {"remat_policy": "save_attn_gate", "flash_block_q": 256, "flash_block_k": 512},
+    {"remat_policy": "save_attn_gate", "flash_block_q": 1024, "flash_block_k": 1024},
+    {"remat_policy": "save_attn_gate", "flash_block_q": 512, "flash_block_k": 512},
+    {"remat_policy": "save_attn_gate", "flash_block_q": 1024, "flash_block_k": 2048},
+    # dot-attention comparison
+    {"remat_policy": "save_attn_gate", "attention_impl": "dot"},
+    # scan unroll
+    {"remat_policy": "save_attn_gate", "scan_unroll": 2},
+    {"remat_policy": "save_attn_gate", "scan_unroll": 4},
+    # bf16 first moment frees ~2.7GB HBM -> bigger batch may fit
+    {"remat_policy": "save_attn_gate", "mu_dtype": "bfloat16", "batch": 8},
+    {"remat_policy": "save_attn", "mu_dtype": "bfloat16", "batch": 8},
+    {"remat_policy": "nothing", "batch": 8},
+]
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        grid = [json.loads(sys.argv[2])]
+    else:
+        grid = GRID
+    for cfg in grid:
+        if cfg.pop("no_remat", None):
+            cfg = {**cfg, "remat": False}
+        rec = run_one(cfg)
+        with open(RESULTS, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -96,6 +96,23 @@ def test_model_level_ring_attention_via_default_mesh():
     np.testing.assert_allclose(np.asarray(got), np.asarray(expect), atol=2e-4)
 
 
+def test_model_level_ulysses_attention_via_default_mesh():
+    """LlamaConfig(attention_impl='ulysses') end to end on an sp mesh."""
+    from tony_tpu.models.llama import LlamaConfig, forward, init_params
+
+    from tony_tpu.parallel.mesh import set_default_mesh
+
+    # sp=4 == tiny()'s n_heads: ulysses requires n_heads % sp == 0
+    set_default_mesh(build_mesh(MeshShape(sp=4)))
+    cfg_uly = LlamaConfig.tiny(attention_impl="ulysses")
+    cfg_dot = LlamaConfig.tiny(attention_impl="dot")
+    params = init_params(jax.random.key(0), cfg_dot)
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg_dot.vocab_size)
+    expect = forward(params, tokens, cfg_dot)
+    got = forward(params, tokens, cfg_uly)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect), atol=2e-4)
+
+
 class TestPipeline:
     def _mesh(self, n):
         return Mesh(np.array(jax.devices()[:n]).reshape(n), ("pp",))

@@ -86,6 +86,8 @@ class ApplicationMaster(ApplicationRpcServicer):
         self._last_metrics_event: dict[str, float] = {}
         self._metrics_event_min_interval_s = 30.0
         self._scheduler_mode = config.get_str(Keys.SCHEDULER_MODE, "GANG").upper()
+        # serializes am.state.json writes (scheduler + supervise threads)
+        self._am_state_write_lock = threading.Lock()
 
     # --- executor launch ----------------------------------------------------
 
@@ -243,6 +245,9 @@ class ApplicationMaster(ApplicationRpcServicer):
         log.info("stop requested: %s", request.reason)
         self.session.diagnostics = request.reason or "stopped by client"
         self._killed.set()
+        # unblock a schedule_all in flight (e.g. mid gang-restart) so the
+        # stop is honoured now, not after allocation completes
+        self.scheduler.stop()
         self._notifications.put(("stop", None))
         return pb.Empty()
 
@@ -286,9 +291,10 @@ class ApplicationMaster(ApplicationRpcServicer):
                 },
             }
         path = self._am_state_path()
-        with open(path + ".tmp", "w") as f:
-            json.dump(snap, f)
-        os.replace(path + ".tmp", path)
+        with self._am_state_write_lock:
+            with open(path + ".tmp", "w") as f:
+                json.dump(snap, f)
+            os.replace(path + ".tmp", path)
 
     def _recover_from_previous_attempt(self) -> None:
         """Attempt N+1 startup: reap the predecessor's orphaned container
@@ -449,12 +455,18 @@ class ApplicationMaster(ApplicationRpcServicer):
     def _finish_task(self, job_name: str, index: int, exit_code: int) -> None:
         self.session.on_task_completed(job_name, index, exit_code)
         t = self.session.task(job_name, index)
+        if t is not None:
+            # the container process group is gone; drop its pid from the
+            # journal so a successor AM attempt never kill_orphan()s a
+            # recycled pid (possibly an unrelated process group)
+            t.container_pid = 0
         self.events.emit(
             EventType.TASK_FINISHED,
             task=f"{job_name}:{index}",
             exit_code=exit_code,
             state=t.state.value if t else "",
         )
+        self._write_am_state()
         log.info("task %s:%d finished code=%d", job_name, index, exit_code)
 
     def _check_heartbeats(self) -> None:
@@ -475,6 +487,9 @@ class ApplicationMaster(ApplicationRpcServicer):
             self.events.emit(EventType.TASK_FINISHED, task=t.task_id, state="LOST")
             if t.container_id:
                 self.backend.release(t.container_id)
+            # container_pid is intentionally KEPT: release() is best-effort
+            # (an unreachable host ignores the kill), so a successor AM
+            # attempt must still be able to reap this possible orphan.
 
     def _apply_failure_policy(self) -> bool:
         """Handle failed/lost tracked tasks. Returns True if the job is over."""

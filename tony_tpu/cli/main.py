@@ -87,17 +87,18 @@ def cmd_status(args: argparse.Namespace) -> int:
 def cmd_logs(args: argparse.Namespace) -> int:
     app_dir = resolve_app_dir(args.app)
     logs_dir = os.path.join(app_dir, "logs")
-    names = sorted(os.listdir(logs_dir)) if os.path.isdir(logs_dir) else []
-    if args.task:
-        prefix = args.task.replace(":", "_") + "_"
-        names = [n for n in names if n.startswith(prefix)]
     if args.am:
-        names = ["../am.log"]
-    if not names:
+        entries = [("am.log", os.path.join(app_dir, "am.log"))]
+    else:
+        names = sorted(os.listdir(logs_dir)) if os.path.isdir(logs_dir) else []
+        if args.task:
+            prefix = args.task.replace(":", "_") + "_"
+            names = [n for n in names if n.startswith(prefix)]
+        entries = [(n, os.path.join(logs_dir, n)) for n in names]
+    if not entries:
         print("no logs found", file=sys.stderr)
         return 1
-    for name in names:
-        path = os.path.join(logs_dir, name)
+    for name, path in entries:
         print(f"===== {name} =====")
         try:
             with open(path, errors="replace") as f:

@@ -35,6 +35,7 @@ import shlex
 import signal
 import subprocess
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import IO, Mapping, Protocol, Sequence
 
@@ -138,6 +139,9 @@ class _SshProcess:
     def poll(self) -> int | None:
         return self._proc.poll()
 
+    def terminate(self) -> None:
+        self._proc.terminate()
+
 
 class SshTransport:
     """Launch containers over ssh.
@@ -167,6 +171,11 @@ class SshTransport:
         # setsid => new session, sid == pid of the sh; echo it before exec.
         return f"setsid sh -c 'echo $$; exec env {exports} {inner}'"
 
+    # Bounds the pid-line wait: a connection that succeeds but whose remote
+    # command is slow to echo must not wedge the scheduler thread forever
+    # (ConnectTimeout only covers the connect phase).
+    PID_READ_TIMEOUT_S = 30.0
+
     def exec_on(self, host, argv, env, log_file):
         proc = subprocess.Popen(
             self._ssh + [host, self._remote_command(argv, env)],
@@ -174,17 +183,29 @@ class SshTransport:
             stderr=log_file,
             start_new_session=True,
         )
-        pid_line = proc.stdout.readline().strip()
-        try:
-            remote_pid = int(pid_line)
-        except ValueError:
-            remote_pid = 0
-        # after the pid line, pump the rest of stdout into the log file
-        t = threading.Thread(
-            target=self._pump, args=(proc.stdout, log_file), daemon=True
-        )
-        t.start()
-        return _SshProcess(proc, remote_pid)
+        sshp = _SshProcess(proc, 0)
+
+        # The reader outlives the timeout: on an overloaded host the pid line
+        # may arrive after we've returned, and a late update to sshp.pid is
+        # what lets release()/kill_pg still reach the remote process group
+        # (the echo is sh's first act, so "never arrives" means sh never
+        # started and there is nothing remote to leak).
+        def _read():
+            line = proc.stdout.readline()
+            if line:
+                try:
+                    sshp.pid = int(line.strip())
+                except ValueError:
+                    log.warning("bad pid line from %s: %r", host, line[:80])
+                self._pump(proc.stdout, log_file)
+
+        threading.Thread(target=_read, daemon=True).start()
+        deadline = time.monotonic() + self.PID_READ_TIMEOUT_S
+        while sshp.pid == 0 and proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if sshp.pid <= 0:
+            log.warning("no pid line from %s yet; continuing (pid may arrive late)", host)
+        return sshp
 
     @staticmethod
     def _pump(src, dst) -> None:
@@ -296,15 +317,26 @@ class RemoteBackend:
         return any(r.fits_in(s.capacity) for s in self._hosts)
 
     def reserve(self, r: Resource) -> None:
-        """AM footprint: the AM runs on the client/coordinator host, not on a
-        worker host, so reservation is accounted against the first host only
-        when it is this machine; otherwise it is free."""
+        """AM footprint. When this machine is part of the inventory (some
+        configured host resolves as local), the AM's resources come out of
+        that host's capacity like any container. Otherwise the AM runs
+        OFF-inventory (the usual pod-slice layout: AM on the coordinator VM,
+        workers on the slice) and its footprint is not counted — stated out
+        loud so gang-allocation math never silently drifts."""
         with self._lock:
             for s in self._hosts:
                 if s.host in ("127.0.0.1", "localhost", local_host()):
                     if r.fits_in(s.available()):
                         s.in_use = s.in_use + r
+                    else:
+                        log.warning(
+                            "AM footprint %s does not fit host %s; "
+                            "not accounted", r, s.host,
+                        )
                     return
+        log.info(
+            "AM host not in cluster.hosts; AM footprint %s runs off-inventory", r
+        )
 
     def _place(self, request: ContainerRequest) -> _HostSlot:
         if request.node_label and not any(
@@ -397,13 +429,17 @@ class RemoteBackend:
                 return
             self._released.add(container_id)
         if proc is not None and proc.poll() is None:
-            self.transport.kill_pg(container.host, container.pid, signal.SIGTERM)
+            # proc.pid is live (an SshTransport pid can arrive late), unlike
+            # the snapshot taken into container.pid at allocate time
+            if proc.pid <= 0 and hasattr(proc, "terminate"):
+                proc.terminate()  # no remote pid: tear down the local client
+            self.transport.kill_pg(container.host, proc.pid, signal.SIGTERM)
             try:
                 t = self._waiters.get(container_id)
                 if t is not None:
                     t.join(timeout=3)
                 if proc.poll() is None:
-                    self.transport.kill_pg(container.host, container.pid, signal.SIGKILL)
+                    self.transport.kill_pg(container.host, proc.pid, signal.SIGKILL)
             except Exception:
                 pass
 
@@ -414,8 +450,9 @@ class RemoteBackend:
             self._released.update(cids)
         for cid in cids:
             c = self._containers[cid]
-            if self._procs[cid].poll() is None:
-                self.transport.kill_pg(c.host, c.pid, signal.SIGKILL)
+            proc = self._procs[cid]
+            if proc.poll() is None:
+                self.transport.kill_pg(c.host, proc.pid, signal.SIGKILL)
         for t in list(self._waiters.values()):
             t.join(timeout=10)
 

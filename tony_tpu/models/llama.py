@@ -294,6 +294,10 @@ def _get_attention(cfg: LlamaConfig) -> AttnFn:
             from tony_tpu.parallel.ring_attention import ring_attention
 
             return ring_attention
+        if cfg.attention_impl == "ulysses":
+            from tony_tpu.parallel.ulysses import ulysses_attention
+
+            return ulysses_attention
     except ImportError as e:
         raise NotImplementedError(
             f"attention_impl={cfg.attention_impl!r} backend not available: {e}"

@@ -321,6 +321,8 @@ def flash_attention(
     Hkv = k.shape[2]
     if H % Hkv:
         raise ValueError(f"n_heads {H} not a multiple of n_kv_heads {Hkv}")
+    if v.shape != k.shape:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
     if block_q is None:
         block_q = getattr(cfg, "flash_block_q", None) or 512
     if block_k is None:
@@ -355,6 +357,14 @@ def sharded_flash_attention(q, k, v, cfg=None, **kwargs) -> jax.Array:
     mesh = get_default_mesh()
     if mesh is None or mesh.size == 1:
         return flash_attention(q, k, v, cfg, **kwargs)
+    # GQA under tp: the heads axis is sharded over tp, so the narrower K/V
+    # head dim must also divide tp — when it doesn't, fall back to expanding
+    # K/V to full width in HBM (correct, just not the bandwidth-lean path).
+    tp = int(mesh.shape.get("tp", 1))
+    if k.shape[2] % tp:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     spec = attn_spec(mesh)  # seq_axis=None: sequence stays device-local
     return jax.shard_map(
         lambda a, b, c: flash_attention(a, b, c, cfg, **kwargs),

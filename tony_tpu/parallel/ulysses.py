@@ -70,4 +70,18 @@ def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "sp"):
     return attn
 
 
-__all__ = ["make_ulysses_attention", "ulysses_attention_local"]
+def ulysses_attention(q, k, v, cfg=None):
+    """Model hook (AttnFn signature): uses the registered default mesh."""
+    from tony_tpu.parallel.mesh import get_default_mesh
+
+    mesh = get_default_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "ulysses attention needs a mesh: call "
+            "tony_tpu.parallel.set_default_mesh(mesh) (fit() does this "
+            "automatically for its training mesh)"
+        )
+    return make_ulysses_attention(mesh)(q, k, v, cfg)
+
+
+__all__ = ["make_ulysses_attention", "ulysses_attention", "ulysses_attention_local"]
