@@ -6,6 +6,20 @@ Prints ONE JSON line:
 The reference publishes no throughput numbers (BASELINE.md: "published": {});
 the driver's north star is tokens/sec/chip and >= 45% MFU, so ``vs_baseline``
 reports achieved MFU / 0.45 (1.0 = the north-star target).
+
+The primary line is the 1.35B-param dense train step (the largest dense
+config whose AdamW state + activations fit one v5e's 16GB HBM — Llama-2-7B
+itself cannot fit a single chip, noted in extra.note). ``extra`` carries two
+more benchmark results so they land in the driver's BENCH json without
+breaking the one-line contract: a flash-vs-dot attention kernel comparison at
+S=8192 and a MoE (GShard top-2) train line, plus a TPU-executed
+flash-matches-dot correctness check (the CPU test suite only exercises the
+Pallas kernels in interpreter mode).
+
+Tuning provenance (scripts/perf_sweep.py, round 3): remat save_attn_kernel
+(keep q/k/v + flash residuals; bwd skips qkv projections, rope, and the
+flash fwd kernel) + bf16 Adam first moment (frees 2.7GB to fund those saves)
++ flash blocks 1024/1024 moved single-chip MFU 52.9% -> 58.6%.
 """
 
 from __future__ import annotations
@@ -18,30 +32,27 @@ import jax
 import jax.numpy as jnp
 
 
-def run_bench() -> dict:
-    from tony_tpu.models.llama import LlamaConfig, train_flops_per_token
+def _fence(x) -> float:
+    # float() (device_get) is the sync point -- block_until_ready is not a
+    # reliable fence on the axon relay platform.
+    return float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
+
+
+def train_bench(cfg, batch: int, seq: int, steps: int, mu_dtype) -> dict:
+    """One sharded train-step benchmark; returns tok/s + MFU + loss."""
+    from tony_tpu.models.llama import train_flops_per_token
     from tony_tpu.obs.metrics import StepTimer, chip_peak_flops
     from tony_tpu.parallel.mesh import single_device_mesh
     from tony_tpu.train.trainer import default_optimizer, make_train_state, make_train_step
 
-    on_tpu = jax.devices()[0].platform != "cpu"
-    if on_tpu:
-        cfg = LlamaConfig.bench_1b4(attention_impl="flash")
-        batch, seq, steps = 4, 2048, 10
-    else:  # CPU fallback so the driver always gets a line
-        cfg = LlamaConfig.tiny()
-        batch, seq, steps = 4, 64, 3
-
     mesh = single_device_mesh()
-    opt = default_optimizer(warmup_steps=10, decay_steps=1000)
+    opt = default_optimizer(warmup_steps=10, decay_steps=1000, mu_dtype=mu_dtype)
     state = make_train_state(jax.random.key(0), cfg, mesh, opt)
     step = make_train_step(cfg, mesh, opt)
     tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size)
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
 
-    # warmup / compile. NOTE: float() (device_get) is the sync point --
-    # block_until_ready is not a reliable fence on the axon relay platform.
-    state, metrics = step(state, inputs, targets)
+    state, metrics = step(state, inputs, targets)  # compile
     state, metrics = step(state, inputs, targets)
     float(metrics["loss"])
 
@@ -55,25 +66,147 @@ def run_bench() -> dict:
         state, metrics = step(state, inputs, targets)
     final_loss = float(metrics["loss"])  # sync fence
     timer.record(time.perf_counter() - t0, steps)
-
-    peak = chip_peak_flops()
-    mfu = timer.mfu(peak)
     return {
-        "metric": "llama1.4b_train_tokens_per_sec_per_chip"
-        if on_tpu
-        else "llama_tiny_cpu_tokens_per_sec",
-        "value": round(timer.tokens_per_sec_per_chip, 1),
+        "tokens_per_sec_per_chip": round(timer.tokens_per_sec_per_chip, 1),
+        "mfu": round(timer.mfu(chip_peak_flops()), 4),
+        "loss": round(final_loss, 4),
+        "batch": batch,
+        "seq": seq,
+        "steps": steps,
+    }
+
+
+def kernel_bench_s8192(steps: int = 8) -> dict:
+    """Flash (Pallas) vs dot (XLA) attention at S=8192: fwd+bwd TF/s.
+
+    24 applications per jitted call (mirrors the model's scan) so the relay's
+    per-dispatch overhead doesn't drown the kernel time.
+    """
+    from tony_tpu.ops.attention import flash_attention
+
+    B, S, H, D = 1, 8192, 16, 128
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
+    from tony_tpu.models.llama import dot_attention
+
+    reps = 24
+    fwd = 4 * B * H * S * S * D / 2        # QK^T + PV matmuls, causal half
+    flops = 3.5 * fwd * reps               # + bwd: 5 more matmuls = 2.5x fwd
+
+    def scan_grad(attn):
+        def loss(qq):
+            def body(c, _):
+                return attn(c, k, v), None
+            out, _ = jax.lax.scan(body, qq, None, length=reps)
+            return jnp.sum(out.astype(jnp.float32))
+        return jax.jit(jax.grad(loss))
+
+    out = {}
+    for name, attn in [
+        ("flash", lambda a, b, c: flash_attention(a, b, c, causal=True)),
+        ("dot", dot_attention),
+    ]:
+        try:
+            fn = scan_grad(attn)
+            _fence(fn(q)); _fence(fn(q))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                o = fn(q)
+            _fence(o)
+            dt = (time.perf_counter() - t0) / steps
+            out[name] = {"ms": round(dt * 1e3, 1), "tflops": round(flops / dt / 1e12, 1)}
+        except Exception as e:
+            msg = f"{type(e).__name__}: {str(e)[:120]}"
+            if name == "dot":
+                # expected: dot materializes the [S,S] fp32 scores — 4.3GB
+                # per layer at S=8192 — which is exactly the memory wall the
+                # flash kernel removes
+                msg = "infeasible at S=8192 (materializes 4.3GB scores/layer); " + msg
+            out[name] = {"error": msg}
+    if "tflops" in out.get("flash", {}) and "tflops" in out.get("dot", {}):
+        out["flash_speedup"] = round(out["flash"]["tflops"] / out["dot"]["tflops"], 2)
+    return out
+
+
+def flash_matches_dot_on_tpu() -> bool:
+    """Correctness of the Pallas kernels on REAL hardware (the CPU suite
+    runs them in interpreter mode only)."""
+    from tony_tpu.models.llama import dot_attention
+    from tony_tpu.ops.attention import flash_attention
+
+    B, S, H, D = 2, 512, 4, 128
+    ks = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
+    got = flash_attention(q, k, v, causal=True, block_q=256, block_k=256)
+    want = dot_attention(q, k, v)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32))))
+    if err > 2e-2:
+        raise AssertionError(f"flash != dot on TPU: max abs err {err}")
+    return True
+
+
+def run_bench() -> dict:
+    from tony_tpu.models.llama import LlamaConfig
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if not on_tpu:  # CPU fallback so the driver always gets a line
+        cfg = LlamaConfig.tiny()
+        r = train_bench(cfg, batch=4, seq=64, steps=3, mu_dtype=jnp.float32)
+        return {
+            "metric": "llama_tiny_cpu_tokens_per_sec",
+            "value": r["tokens_per_sec_per_chip"],
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(r["mfu"] / 0.45, 4),
+            "extra": {"device": jax.devices()[0].device_kind, **r},
+        }
+
+    cfg = LlamaConfig.bench_1b4(
+        attention_impl="flash", remat_policy="save_attn_kernel"
+    )
+    main = train_bench(cfg, batch=4, seq=2048, steps=10, mu_dtype=jnp.bfloat16)
+
+    extra = {
+        "device": jax.devices()[0].device_kind,
+        "n_params": cfg.n_params,
+        "remat_policy": cfg.remat_policy,
+        "mu_dtype": "bfloat16",
+        "note": (
+            "1.35B is the largest dense config fitting one v5e (16GB HBM) "
+            "with AdamW state; llama2_7b needs >56GB and is a multi-chip "
+            "config (see dryrun_multichip)"
+        ),
+        **main,
+    }
+    try:
+        extra["flash_matches_dot_on_tpu"] = flash_matches_dot_on_tpu()
+    except Exception as e:
+        extra["flash_matches_dot_on_tpu"] = f"{type(e).__name__}: {str(e)[:120]}"
+    extra["attn_kernel_s8192"] = kernel_bench_s8192()
+    try:
+        # 4 experts (~1.2B total / ~700M active): the 8-expert preset's
+        # AdamW state alone exceeds the chip's 16GB
+        moe_cfg = LlamaConfig.bench_moe(
+            n_experts=4, attention_impl="flash", remat_policy="save_attn_kernel"
+        )
+        moe = train_bench(moe_cfg, batch=4, seq=2048, steps=10, mu_dtype=jnp.bfloat16)
+        extra["moe_top2"] = {
+            "n_params": moe_cfg.n_params,
+            "n_active_params": moe_cfg.n_active_params,
+            **moe,
+        }
+    except Exception as e:
+        extra["moe_top2"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+    return {
+        "metric": "llama1.4b_train_tokens_per_sec_per_chip",
+        "value": main["tokens_per_sec_per_chip"],
         "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "extra": {
-            "mfu": round(mfu, 4),
-            "device": jax.devices()[0].device_kind,
-            "n_params": cfg.n_params,
-            "batch": batch,
-            "seq": seq,
-            "steps": steps,
-            "loss": round(final_loss, 4),
-        },
+        "vs_baseline": round(main["mfu"] / 0.45, 4),
+        "extra": extra,
     }
 
 

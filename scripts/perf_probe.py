@@ -1,0 +1,56 @@
+"""Step-decomposition probes on the real chip (run with
+PYTHONPATH=/root/repo:/root/.axon_site to keep the axon sitecustomize).
+
+Measures, per remat policy: fwd-only loss time, fwd+bwd time and their
+ratio (full recompute ~4x fwd, no recompute ~3x), and MFU — the numbers
+behind bench.py's tuning choices. See also /tmp traces via jax.profiler.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.models.llama import LlamaConfig, init_params, loss_from_pairs, train_flops_per_token
+from tony_tpu.obs.metrics import chip_peak_flops
+
+B, S = 4, 2048
+peak = chip_peak_flops()
+
+
+def fence(out):
+    float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))
+
+
+def timeit(fn, *args, n=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    fence(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> None:
+    tokens = jax.random.randint(jax.random.key(1), (B, S + 1), 0, 32000)
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    for policy in ["nothing", "save_attn_kernel", "save_attn_gate"]:
+        cfg = LlamaConfig.bench_1b4(attention_impl="flash", remat_policy=policy)
+        params = jax.jit(functools.partial(init_params, cfg=cfg))(jax.random.key(0))
+        lossf = jax.jit(functools.partial(loss_from_pairs, cfg=cfg))
+        gradf = jax.jit(jax.value_and_grad(functools.partial(loss_from_pairs, cfg=cfg)))
+        t_fwd = timeit(lossf, params, inp, tgt)
+        t_grad = timeit(gradf, params, inp, tgt)
+        counted = B * S * train_flops_per_token(cfg, S)
+        print(
+            f"policy={policy}: fwd {t_fwd*1e3:.1f}ms grad {t_grad*1e3:.1f}ms "
+            f"ratio {t_grad/t_fwd:.2f} grad-mfu {counted/t_grad/peak:.3f}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

