@@ -468,3 +468,47 @@ def test_gang_restart_resumes_from_checkpoint(tmp_path):
     resumed = int(_re.search(r"resumed from checkpoint step (\d+)", log_text).group(1))
     assert resumed >= 2
     assert any(d.isdigit() and int(d) == 10 for d in os.listdir(ckpt_dir))
+
+
+def test_notebook_submitter_proxied_roundtrip(tmp_path):
+    """NotebookSubmitter + tony-proxy composition: a single-container notebook
+    job announces its URL through the AM, the client proxies to it, and an
+    HTTP GET through the local proxy port reaches the container's server."""
+    import urllib.request
+
+    from tony_tpu.cli.notebook import launch_notebook, notebook_config
+    from tony_tpu.rpc import ApplicationRpcClient
+
+    base = TonyConfig.load(
+        overrides={**FAST, "application.stage_dir": str(tmp_path),
+                   "application.name": "nb"}
+    )
+    config = notebook_config(base, memory_mb=256)
+    assert config.task_specs().keys() == {"notebook"}
+    client, proxy, url = launch_notebook(config, timeout_s=60)
+    try:
+        import time as _time
+
+        # the URL is announced before jupyter finishes booting; retry the GET
+        body, status = "", 0
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{proxy.port}/", timeout=10
+                ) as r:
+                    body, status = r.read().decode(), r.status
+                break
+            except OSError:
+                _time.sleep(0.5)
+        assert status == 200
+        # jupyter when installed (this image ships it), else the fallback page
+        assert "tony-tpu notebook" in body or "jupyter" in body.lower()
+    finally:
+        addr = open(os.path.join(client.app_dir, "am.addr")).read().strip()
+        with ApplicationRpcClient(addr) as c:
+            c.stop_application("test done")
+        code = client.monitor(quiet=True)
+        proxy.stop()
+    assert code == 143  # KILLED
+    assert read_status(client.app_dir)["state"] == "KILLED"

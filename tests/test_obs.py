@@ -30,6 +30,14 @@ def fake_app(tmp_path):
         "application.name": "j", "application.framework": "jax"}))
     (app_dir / "events" / "job-1.jhist.jsonl").write_text(
         json.dumps({"type": "APPLICATION_INITED", "ts": 1.0, "app_id": "job-1"}) + "\n"
+        + json.dumps({"type": "METRICS", "ts": 2.0, "app_id": "job-1",
+                      "task": "worker:0",
+                      "samples": {"mfu": 0.41, "tokens_per_sec": 1200.5,
+                                  "rss_mb": 512.0, "hbm_mb": 9001.0}}) + "\n"
+        + json.dumps({"type": "METRICS", "ts": 3.0, "app_id": "job-1",
+                      "task": "worker:0",
+                      "samples": {"mfu": 0.52, "tokens_per_sec": 1400.0,
+                                  "rss_mb": 520.0, "hbm_mb": 9002.0}}) + "\n"
     )
     return tmp_path
 
@@ -60,12 +68,38 @@ class TestPortal:
             assert status == 200 and json.loads(body)[0]["app_id"] == "job-1"
             status, body = get("/job/job-1")
             assert status == 200 and "SUCCEEDED" in body
+            # metrics table: latest sample per task, not a raw JSON dump
+            assert "<h2>metrics</h2>" in body
+            assert "0.52" in body and "1400" in body and "9002" in body
+            from tony_tpu.obs.portal import PortalData, _latest_metrics
+
+            detail = PortalData(str(fake_app)).job("job-1")
+            latest = _latest_metrics(detail["events"])
+            assert latest["worker:0"]["mfu"] == 0.52  # superseded 0.41 gone
             status, body = get("/job/job-1/log/worker_0_attempt0.log")
             assert status == 200 and body == "hello log\n"
             with pytest.raises(urllib.error.HTTPError):
                 get("/job/nope")
         finally:
             server.shutdown()
+
+
+def test_tpu_metrics_source_shape():
+    """The device-metrics source yields well-formed Samples on platforms
+    exposing memory_stats, and degrades to [] (never raises) elsewhere —
+    bench.py's environment exercises the populated path on the real chip."""
+    from tony_tpu.obs.monitor import TaskMonitor
+    from tony_tpu.obs.tpu_metrics import tpu_memory_samples, tpu_metrics_dict
+
+    samples = tpu_memory_samples()
+    for name, value, ts in samples:
+        assert name.startswith("hbm_") and value >= 0 and ts > 0
+    d = tpu_metrics_dict()
+    assert set(d) == {name for name, _, _ in samples}
+    # plugs into the monitor's extra_sources seam
+    mon = TaskMonitor(extra_sources=[tpu_memory_samples])
+    names = {name for name, _, _ in mon.sample()}
+    assert "rss_mb" in names
 
 
 def test_proxy_relays_bytes():

@@ -124,6 +124,27 @@ def cmd_stop(args: argparse.Namespace) -> int:
         return 1
 
 
+def cmd_notebook(args: argparse.Namespace) -> int:
+    from tony_tpu.cli.notebook import launch_notebook, notebook_config
+
+    base = TonyConfig.load(args.conf, overrides=args.define, read_env=True)
+    config = notebook_config(
+        base, memory_mb=args.memory_mb, cpus=args.cpus, tpu_chips=args.tpu_chips
+    )
+    try:
+        client, proxy, url = launch_notebook(config, listen_port=args.listen)
+    except (RuntimeError, TimeoutError) as e:
+        print(f"notebook failed to start: {e}", file=sys.stderr)
+        return 1
+    print(f"[{client.app_id}] notebook at http://127.0.0.1:{proxy.port}/ "
+          f"(proxied to {url})")
+    print(f"stop with: tony stop {client.app_id}")
+    try:
+        return client.monitor(quiet=args.quiet)
+    finally:
+        proxy.stop()
+
+
 def cmd_history(args: argparse.Namespace) -> int:
     root = args.dir or default_apps_root()
     rows = []
@@ -170,6 +191,22 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--task", help="restrict to one task, e.g. worker:0")
     s.add_argument("--am", action="store_true", help="show the AM log")
     s.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser(
+        "notebook", help="run a single-container notebook and proxy to it"
+    )
+    s.add_argument("--conf", help="TOML config (cluster/security settings)")
+    s.add_argument(
+        "-D", "--define", action="append", default=[], metavar="KEY=VALUE",
+        help="config override (repeatable)",
+    )
+    s.add_argument("--listen", type=int, default=0,
+                   help="local proxy port (default: ephemeral)")
+    s.add_argument("--memory-mb", type=int, default=2048)
+    s.add_argument("--cpus", type=int, default=1)
+    s.add_argument("--tpu-chips", type=int, default=0)
+    s.add_argument("--quiet", action="store_true")
+    s.set_defaults(fn=cmd_notebook)
 
     s = sub.add_parser("stop", help="stop a running application")
     s.add_argument("app")

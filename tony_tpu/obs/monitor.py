@@ -63,12 +63,14 @@ def _children(pid: int) -> list[int]:
 class TaskMonitor:
     """Samples this process tree's cpu%/rss; extend via ``extra_sources``."""
 
-    def __init__(self, pid: int | None = None):
+    def __init__(self, pid: int | None = None, extra_sources: list | None = None):
         self.pid = pid or os.getpid()
         self._last_jiffies = 0.0
         self._last_t = 0.0
-        # callables returning extra samples, e.g. TPU duty cycle
-        self.extra_sources: list = []
+        # callables returning extra samples — e.g. obs.tpu_metrics.
+        # tpu_memory_samples in a process that owns the TPU (the executor's
+        # own monitor must NOT import jax: the chip belongs to the child)
+        self.extra_sources: list = list(extra_sources or [])
 
     def sample(self) -> list[Sample]:
         now = time.time()

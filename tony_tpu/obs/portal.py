@@ -127,6 +127,45 @@ def _jobs_html(jobs: list[dict]) -> str:
     )
 
 
+def _latest_metrics(events: list[dict]) -> dict[str, dict]:
+    """task -> latest METRICS samples (the portal's utilisation view; the
+    reference charts the utilisation embedded in its history events the same
+    way, SURVEY.md section 3.5)."""
+    latest: dict[str, dict] = {}
+    for e in events:
+        if e.get("type") == "METRICS" and isinstance(e.get("samples"), dict):
+            latest[str(e.get("task", "?"))] = e["samples"]
+    return latest
+
+
+def _metrics_html(metrics: dict[str, dict]) -> str:
+    if not metrics:
+        return "<p>(no metrics reported)</p>"
+    # stable column order: the headline numbers first, then the rest
+    preferred = ["step", "loss", "tokens_per_sec", "tokens_per_sec_per_chip",
+                 "mfu", "grad_norm", "cpu_percent", "rss_mb", "hbm_mb",
+                 "hbm_peak_mb"]
+    seen = {k for samples in metrics.values() for k in samples}
+    cols = [c for c in preferred if c in seen]
+    cols += sorted(seen - set(cols))
+    head = "".join(f"<th>{html.escape(c)}</th>" for c in cols)
+    rows = ""
+    for task in sorted(metrics):
+        cells = "".join(
+            f"<td>{_fmt_num(metrics[task].get(c))}</td>" for c in cols
+        )
+        rows += f"<tr><td>{html.escape(task)}</td>{cells}</tr>"
+    return f"<table><tr><th>task</th>{head}</tr>{rows}</table>"
+
+
+def _fmt_num(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return html.escape(str(v))
+
+
 def _job_html(detail: dict) -> str:
     app_id = html.escape(detail["app_id"])
     status = detail["status"] or {}
@@ -151,6 +190,7 @@ def _job_html(detail: dict) -> str:
         f" exit={status.get('exit_code')}</p>"
         f"<h2>tasks</h2><table><tr><th>task</th><th>state</th><th>exit</th>"
         f"<th>attempts</th></tr>{tasks}</table>"
+        f"<h2>metrics</h2>{_metrics_html(_latest_metrics(detail['events']))}"
         f"<h2>logs</h2><ul>{logs}</ul>"
         f"<h2>events</h2><pre>{events}</pre>"
         f"<h2>config</h2><pre>{config}</pre>"

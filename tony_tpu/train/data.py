@@ -32,6 +32,10 @@ class DataConfig:
     vocab_size: int = 32000
     seed: int = 0
     path: str = ""  # empty -> synthetic
+    # token files route through the C++ prefetching loader (shuffled epochs,
+    # IO off the GIL) when it can build; False pins the numpy mmap path
+    # (deterministic sequential windows)
+    native: bool = True
 
 
 def _local_slice(global_batch: int) -> tuple[int, int]:
@@ -95,11 +99,59 @@ def _to_global(tokens: np.ndarray, sharding: NamedSharding | None) -> Batch:
     )
 
 
+def native_batches(
+    cfg: DataConfig, sharding: NamedSharding | None = None, start_step: int = 0
+) -> Iterator[Batch]:
+    """Prefetched shuffled windows via the C++ loader (train/native_loader).
+
+    Same contract as mmap_batches — per-process [per, seq_len+1] chunks,
+    ``start_step`` resume-exact via seek() — but each epoch visits every
+    window of this process's shard once in a seeded order, and the read +
+    shuffle + copy happens on a native thread that overlaps the device step.
+    """
+    from tony_tpu.train.native_loader import NativeTokenLoader
+
+    per, _ = _local_slice(cfg.global_batch)
+    loader = NativeTokenLoader(
+        cfg.path, cfg.seq_len, per,
+        n_shards=jax.process_count(), shard_id=jax.process_index(),
+        seed=cfg.seed,
+    )
+    loader.seek(start_step)
+    while True:
+        yield _to_global(loader.next(), sharding)
+
+
 def make_batches(
     cfg: DataConfig, sharding: NamedSharding | None = None, start_step: int = 0
 ) -> Iterator[Batch]:
-    fn = mmap_batches if cfg.path else synthetic_batches
-    return fn(cfg, sharding, start_step)
+    if cfg.path:
+        if cfg.native:
+            from tony_tpu.train import native_loader
+
+            if native_loader.available():
+                # in a gang, every process must take this same branch; a
+                # process whose build fails raises below instead of silently
+                # mixing shuffled and sequential sampling in one global batch
+                return native_batches(cfg, sharding, start_step)
+            if jax.process_count() > 1:
+                raise RuntimeError(
+                    "native token loader unavailable on this host but "
+                    "data.native=True in a multi-process job — the gang "
+                    "would mix sampling schemes. Install g++ everywhere or "
+                    "set DataConfig(native=False)."
+                )
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native loader unavailable; falling back to sequential "
+                "mmap windows (different sampling + resume stream)"
+            )
+        return mmap_batches(cfg, sharding, start_step)
+    return synthetic_batches(cfg, sharding, start_step)
 
 
-__all__ = ["Batch", "DataConfig", "make_batches", "mmap_batches", "synthetic_batches"]
+__all__ = [
+    "Batch", "DataConfig", "make_batches", "mmap_batches", "native_batches",
+    "synthetic_batches",
+]

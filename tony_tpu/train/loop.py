@@ -151,6 +151,11 @@ def fit(cfg: FitConfig) -> dict:
                 "mfu": round(timer.mfu(), 4),
                 "grad_norm": round(float(metrics["grad_norm"]), 4),
             }
+            # HBM usage from the device this process owns (the nvidia-smi
+            # sampling analogue; empty on platforms without memory_stats)
+            from tony_tpu.obs.tpu_metrics import tpu_metrics_dict
+
+            out.update(tpu_metrics_dict())
             if jax.process_index() == 0:
                 log.info(
                     "step %(step)d loss=%(loss)s %(tokens_per_sec_per_chip)s tok/s/chip "
