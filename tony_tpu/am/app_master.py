@@ -277,6 +277,11 @@ class ApplicationMaster(ApplicationRpcServicer):
         process groups exist (to reap orphans) and the restart generation
         (so events/metrics stay monotonic across AM attempts)."""
         with self.session.lock:
+            # refresh pids that were unknown at allocate time (a remote pid
+            # can arrive after launch) so the journal never undercounts
+            for t in self.session.tasks.values():
+                if t.container_id and not t.container_pid and t.state not in TERMINAL:
+                    t.container_pid = self.backend.container_pid(t.container_id)
             snap = {
                 "am_attempt": self.am_attempt,
                 "generation": self.session.generation,
@@ -339,7 +344,8 @@ class ApplicationMaster(ApplicationRpcServicer):
     def _on_container_completed(self, container: Container, code: int) -> None:
         self._notifications.put(
             ("container", (container.request.task_type, container.request.task_index,
-                           container.container_id, code))
+                           container.container_id, code,
+                           container.exit_authoritative))
         )
 
     # --- supervision loop -----------------------------------------------------
@@ -436,14 +442,18 @@ class ApplicationMaster(ApplicationRpcServicer):
                 job_name, index, exit_code, attempt = payload
                 task = self.session.task(job_name, index)
                 if task is not None and attempt == task.attempt:
-                    self._finish_task(job_name, index, exit_code)
+                    # executor-reported: its process group is exiting now
+                    self._finish_task(job_name, index, exit_code, pid_dead=True)
             elif kind == "container":
-                job_name, index, cid, code = payload
+                job_name, index, cid, code, authoritative = payload
                 task = self.session.task(job_name, index)
                 # Only meaningful if this is still the task's current
                 # container and no result was reported (executor crash).
                 if task is not None and task.container_id == cid and task.state not in TERMINAL:
-                    self._finish_task(job_name, index, code if code != 0 else 0)
+                    self._finish_task(
+                        job_name, index, code if code != 0 else 0,
+                        pid_dead=authoritative,
+                    )
             self._check_heartbeats()
             if self._apply_failure_policy():
                 return
@@ -452,13 +462,17 @@ class ApplicationMaster(ApplicationRpcServicer):
                 self.session.state = state
                 return
 
-    def _finish_task(self, job_name: str, index: int, exit_code: int) -> None:
+    def _finish_task(
+        self, job_name: str, index: int, exit_code: int, *, pid_dead: bool = True
+    ) -> None:
         self.session.on_task_completed(job_name, index, exit_code)
         t = self.session.task(job_name, index)
-        if t is not None:
-            # the container process group is gone; drop its pid from the
-            # journal so a successor AM attempt never kill_orphan()s a
-            # recycled pid (possibly an unrelated process group)
+        if t is not None and pid_dead:
+            # the container process group is provably gone; drop its pid from
+            # the journal so a successor AM attempt never kill_orphan()s a
+            # recycled pid. When the exit is NOT authoritative (an ssh
+            # channel died, code 255), the pid stays journalled: the remote
+            # group may still be alive and must remain reapable.
             t.container_pid = 0
         self.events.emit(
             EventType.TASK_FINISHED,

@@ -29,6 +29,12 @@ def make_backend(name: str, config=None, **kwargs) -> ClusterBackend:
             kwargs.setdefault(
                 "transport", config.get_str(Keys.CLUSTER_REMOTE_TRANSPORT, "ssh")
             )
+            kwargs.setdefault(
+                "localize", config.get_bool(Keys.CLUSTER_LOCALIZE, False)
+            )
+            kwargs.setdefault(
+                "localize_root", config.get_str(Keys.CLUSTER_LOCALIZE_ROOT, "")
+            )
             chips = config.get_int(Keys.CLUSTER_TPU_CHIPS_PER_HOST, 4)
             if name == "remote":
                 kwargs.setdefault(

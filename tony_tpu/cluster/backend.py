@@ -97,6 +97,10 @@ class Container:
     state: ContainerState = ContainerState.RUNNING
     exit_code: int | None = None
     pid: int = 0
+    # False when the exit code only proves the *channel* to the container
+    # died (an ssh client exiting 255), not the remote process group itself —
+    # consumers must then treat the group as a possible orphan
+    exit_authoritative: bool = True
 
 
 # (container, exit_code) — fired from a backend thread when a container's
@@ -153,6 +157,12 @@ class ClusterBackend(Protocol):
 
     def allocate(self, request: ContainerRequest) -> Container:
         """Grant + launch a container, or raise :class:`InsufficientResources`."""
+        ...
+
+    def container_pid(self, container_id: str) -> int:
+        """Current process-group pid of a container (0 when unknown). May be
+        fresher than the pid snapshotted at allocate time: a remote pid can
+        arrive after launch (SshTransport's late pid line)."""
         ...
 
     def release(self, container_id: str) -> None:

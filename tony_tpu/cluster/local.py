@@ -176,5 +176,10 @@ class LocalProcessBackend(_InventoryMixin):
         with self._lock:
             return list(self._containers.values())
 
+    def container_pid(self, container_id: str) -> int:
+        with self._lock:
+            c = self._containers.get(container_id)
+        return c.pid if c is not None else 0
+
 
 __all__ = ["LocalProcessBackend"]

@@ -79,6 +79,15 @@ class Transport(Protocol):
 
     def kill_pg(self, host: str, pid: int, sig: int) -> None: ...
 
+    def exit_authoritative(self, code: int) -> bool:
+        """Does this exit code prove the container process group exited?"""
+        ...
+
+    def localize(self, host: str, src_dir: str, dst_dir: str) -> None:
+        """Copy a staged application dir to ``dst_dir`` on ``host`` (the HDFS
+        container-localisation analogue for slices without a shared FS)."""
+        ...
+
 
 # --- local transport (tests / single-host prod) -----------------------------
 
@@ -119,6 +128,14 @@ class LocalTransport:
             os.killpg(pid, sig)
         except (ProcessLookupError, PermissionError):
             pass
+
+    def exit_authoritative(self, code):
+        return True  # local waitpid: the group leader really exited
+
+    def localize(self, host, src_dir, dst_dir):
+        import shutil
+
+        shutil.copytree(src_dir, dst_dir, dirs_exist_ok=True)
 
 
 # --- ssh transport (production) ----------------------------------------------
@@ -184,12 +201,14 @@ class SshTransport:
             start_new_session=True,
         )
         sshp = _SshProcess(proc, 0)
+        got_pid = threading.Event()
 
         # The reader outlives the timeout: on an overloaded host the pid line
         # may arrive after we've returned, and a late update to sshp.pid is
         # what lets release()/kill_pg still reach the remote process group
         # (the echo is sh's first act, so "never arrives" means sh never
-        # started and there is nothing remote to leak).
+        # started and there is nothing remote to leak — unless the local
+        # client is killed first, which release() guards with a grace wait).
         def _read():
             line = proc.stdout.readline()
             if line:
@@ -197,15 +216,46 @@ class SshTransport:
                     sshp.pid = int(line.strip())
                 except ValueError:
                     log.warning("bad pid line from %s: %r", host, line[:80])
+                got_pid.set()
                 self._pump(proc.stdout, log_file)
+            else:
+                got_pid.set()  # EOF: ssh never reached the echo
 
         threading.Thread(target=_read, daemon=True).start()
-        deadline = time.monotonic() + self.PID_READ_TIMEOUT_S
-        while sshp.pid == 0 and proc.poll() is None and time.monotonic() < deadline:
-            time.sleep(0.05)
+        got_pid.wait(self.PID_READ_TIMEOUT_S)
         if sshp.pid <= 0:
             log.warning("no pid line from %s yet; continuing (pid may arrive late)", host)
         return sshp
+
+    def exit_authoritative(self, code):
+        # ssh propagates the remote command's exit code; 255 is ssh's OWN
+        # error (auth/connection loss) and a negative code means the LOCAL
+        # client was signal-killed — neither proves anything about the
+        # remote process group
+        return code != 255 and code >= 0
+
+    def localize(self, host, src_dir, dst_dir):
+        # tar over the ssh channel: no remote daemon, one round trip, and
+        # permissions (the 0600 app.token) survive the copy
+        tar = subprocess.Popen(
+            ["tar", "-C", src_dir, "-cf", "-", "."], stdout=subprocess.PIPE
+        )
+        unpack = subprocess.run(
+            self._ssh + [
+                host,
+                f"mkdir -p {shlex.quote(dst_dir)} && "
+                f"tar -xpf - -C {shlex.quote(dst_dir)}",
+            ],
+            stdin=tar.stdout,
+            capture_output=True,
+            timeout=600,
+        )
+        tar.stdout.close()
+        if tar.wait() != 0 or unpack.returncode != 0:
+            raise RuntimeError(
+                f"localization to {host}:{dst_dir} failed: "
+                f"{unpack.stderr.decode(errors='replace')[-500:]}"
+            )
 
     @staticmethod
     def _pump(src, dst) -> None:
@@ -264,6 +314,8 @@ class RemoteBackend:
         transport: Transport | str = "ssh",
         host_capacity: Resource | None = None,
         host_labels: Mapping[str, str] | None = None,
+        localize: bool = False,
+        localize_root: str = "",
     ):
         if not hosts:
             raise ValueError("RemoteBackend needs at least one host (cluster.hosts)")
@@ -274,6 +326,18 @@ class RemoteBackend:
         self.transport: Transport = (
             make_transport(transport) if isinstance(transport, str) else transport
         )
+        # cluster.localize: copy the staged app dir to each host over the
+        # transport before its first container, instead of requiring a shared
+        # FS at the same path (the reference's HDFS localisation, SURVEY.md
+        # section 3.1). The copy lands under <localize_root>/<host>/<app_id>
+        # and TONY_APP_DIR/TONY_CONF_PATH are rewritten to it — the NM
+        # container-localisation move. Default root assumes the same home
+        # path on every host (the TPU-VM norm).
+        self._localize = localize
+        self._localize_root = localize_root or os.path.expanduser(
+            os.path.join("~", ".tony-tpu", "localized")
+        )
+        self._localized: set[tuple[str, str]] = set()
         self._containers: dict[str, Container] = {}
         self._procs: dict[str, RemoteProcess] = {}
         self._logs: dict[str, IO[bytes]] = {}
@@ -369,6 +433,8 @@ class RemoteBackend:
         env = dict(request.env)
         env["TONY_CONTAINER_ID"] = cid
         try:
+            if self._localize:
+                self._localize_app(slot.host, env)
             proc = self.transport.exec_on(slot.host, request.argv, env, out)
         except Exception:
             out.close()
@@ -400,6 +466,30 @@ class RemoteBackend:
         )
         return container
 
+    def _localize_app(self, host: str, env: dict) -> None:
+        """Copy the app dir to ``host`` once per (host, app) and point the
+        container's TONY_APP_DIR/TONY_CONF_PATH at the localized copy."""
+        app_dir = env.get("TONY_APP_DIR", "")
+        app_id = env.get("TONY_APP_ID") or os.path.basename(app_dir.rstrip("/"))
+        if not app_dir:
+            return
+        dst = os.path.join(self._localize_root, host, app_id)
+        key = (host, app_id)
+        with self._lock:
+            needed = key not in self._localized
+            if needed:
+                self._localized.add(key)
+        if needed:
+            try:
+                self.transport.localize(host, app_dir, dst)
+                log.info("localized %s to %s:%s", app_id, host, dst)
+            except Exception:
+                with self._lock:
+                    self._localized.discard(key)
+                raise
+        env["TONY_APP_DIR"] = dst
+        env["TONY_CONF_PATH"] = os.path.join(dst, "config.json")
+
     def _wait(self, cid: str) -> None:
         proc = self._procs[cid]
         code = proc.wait()
@@ -407,6 +497,8 @@ class RemoteBackend:
             container = self._containers[cid]
             released = cid in self._released
             container.exit_code = code
+            container.pid = proc.pid  # ssh pid may have arrived late
+            container.exit_authoritative = self.transport.exit_authoritative(code)
             container.state = (
                 ContainerState.RELEASED if released else ContainerState.COMPLETED
             )
@@ -430,7 +522,13 @@ class RemoteBackend:
             self._released.add(container_id)
         if proc is not None and proc.poll() is None:
             # proc.pid is live (an SshTransport pid can arrive late), unlike
-            # the snapshot taken into container.pid at allocate time
+            # the snapshot taken into container.pid at allocate time. Give a
+            # late pid a short grace window before giving up on group-kill:
+            # terminating the local ssh client first would strand the
+            # setsid'd remote group with no handle left.
+            grace = time.monotonic() + 3.0
+            while proc.pid <= 0 and proc.poll() is None and time.monotonic() < grace:
+                time.sleep(0.1)
             if proc.pid <= 0 and hasattr(proc, "terminate"):
                 proc.terminate()  # no remote pid: tear down the local client
             self.transport.kill_pg(container.host, proc.pid, signal.SIGTERM)
@@ -459,6 +557,12 @@ class RemoteBackend:
     def containers(self) -> list[Container]:
         with self._lock:
             return list(self._containers.values())
+
+    def container_pid(self, container_id: str) -> int:
+        """Live process-group pid (an ssh pid may arrive after allocate)."""
+        with self._lock:
+            proc = self._procs.get(container_id)
+        return proc.pid if proc is not None else 0
 
 
 __all__ = [

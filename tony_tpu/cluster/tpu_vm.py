@@ -49,6 +49,8 @@ class TpuVmBackend(RemoteBackend):
         project: str = "",
         node: str = "",
         transport: Transport | str = "ssh",
+        localize: bool = False,
+        localize_root: str = "",
     ):
         self.accelerator_type = accelerator_type
         self.zone = zone
@@ -61,6 +63,8 @@ class TpuVmBackend(RemoteBackend):
             hosts,
             transport=transport,
             host_capacity=Resource(memory_mb=1 << 20, cpus=256, tpu_chips=chips),
+            localize=localize,
+            localize_root=localize_root,
         )
 
     def _discover_hosts(self) -> list[str]:

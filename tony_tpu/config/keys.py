@@ -63,6 +63,12 @@ class Keys:
     CLUSTER_TPU_CHIPS_PER_HOST = "cluster.tpu_chips_per_host"
     CLUSTER_HOSTS = "cluster.hosts"  # remote backend: comma list of hosts
     CLUSTER_REMOTE_TRANSPORT = "cluster.remote_transport"  # ssh | local
+    # copy the app dir to each host over the transport (pod slices without a
+    # shared FS) instead of assuming the same path everywhere
+    CLUSTER_LOCALIZE = "cluster.localize"
+    # destination root for localized app dirs (default ~/.tony-tpu/localized,
+    # expanded on the AM host — assumes the same home path on every host)
+    CLUSTER_LOCALIZE_ROOT = "cluster.localize_root"
 
     # --- portal/history ---
     HISTORY_INTERMEDIATE_DIR = "history.intermediate_dir"
@@ -130,6 +136,8 @@ DEFAULTS: dict[str, object] = {
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",
     Keys.CLUSTER_REMOTE_TRANSPORT: "ssh",
+    Keys.CLUSTER_LOCALIZE: False,
+    Keys.CLUSTER_LOCALIZE_ROOT: "",
     Keys.HISTORY_INTERMEDIATE_DIR: "",
     Keys.HISTORY_FINISHED_DIR: "",
     Keys.PORTAL_PORT: 8080,
