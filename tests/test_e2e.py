@@ -512,3 +512,45 @@ def test_notebook_submitter_proxied_roundtrip(tmp_path):
         proxy.stop()
     assert code == 143  # KILLED
     assert read_status(client.app_dir)["state"] == "KILLED"
+
+
+def test_horovod_job_rendezvous_roundtrip(tmp_path):
+    """Milestone config #3 substrate: a framework=horovod job gets a live
+    gloo rendezvous store on the AM — every worker PUTs its own rank key and
+    polls GET for all peers' keys through the HOROVOD_GLOO_RENDEZVOUS_* env,
+    exactly the traffic pattern of gloo's HTTP store bootstrap."""
+    script = (
+        "python -c \""
+        "import os, time, urllib.request, urllib.error;\n"
+        "base = 'http://%s:%s' % (os.environ['HOROVOD_GLOO_RENDEZVOUS_ADDR'],"
+        " os.environ['HOROVOD_GLOO_RENDEZVOUS_PORT']);\n"
+        "rank = os.environ['HOROVOD_RANK']; size = int(os.environ['HOROVOD_SIZE']);\n"
+        "assert size == 2, size;\n"
+        "req = urllib.request.Request(base + '/hvd/rank' + rank,"
+        " data=rank.encode(), method='PUT');\n"
+        "urllib.request.urlopen(req, timeout=10);\n"
+        "deadline = time.time() + 30\n"
+        "for peer in range(size):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            r = urllib.request.urlopen(base + '/hvd/rank%d' % peer, timeout=10)\n"
+        "            assert r.read() == str(peer).encode(); break\n"
+        "        except urllib.error.HTTPError as e:\n"
+        "            assert e.code == 404 and time.time() < deadline\n"
+        "            time.sleep(0.2)\n"
+        "\""
+    )
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "hvd",
+            "application.framework": "horovod",
+            "job.worker.instances": 2,
+            "job.worker.command": script,
+        },
+    )
+    if code != 0:
+        logs_dir = os.path.join(app_dir, "logs")
+        for n in sorted(os.listdir(logs_dir)):
+            print(f"===== {n}", open(os.path.join(logs_dir, n), errors="replace").read()[-1500:])
+    assert code == 0

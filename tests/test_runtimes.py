@@ -82,3 +82,40 @@ def test_jax_initialize_noop_outside_job(monkeypatch):
     monkeypatch.delenv(jax_tpu.ENV_COORDINATOR, raising=False)
     jax_tpu.initialize()  # must not raise or touch jax.distributed
     assert not jax_tpu.in_tony_job()
+
+
+def test_horovod_rendezvous_kv_protocol():
+    """The AM-side gloo rendezvous store: PUT stores, GET polls (404 until
+    present), DELETE drops a scope — the wire contract gloo clients use."""
+    import urllib.error
+    import urllib.request
+
+    from tony_tpu.runtime.horovod_driver import RendezvousServer
+
+    srv = RendezvousServer(host="127.0.0.1").start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        # GET before PUT -> 404 (gloo retries on this)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/job0/rank0", timeout=5)
+        assert e.value.code == 404
+        req = urllib.request.Request(
+            f"{base}/job0/rank0", data=b"addr-of-rank-0", method="PUT"
+        )
+        assert urllib.request.urlopen(req, timeout=5).status == 200
+        with urllib.request.urlopen(f"{base}/job0/rank0", timeout=5) as r:
+            assert r.read() == b"addr-of-rank-0"
+        assert len(srv) == 1
+        req = urllib.request.Request(f"{base}/job0", method="DELETE")
+        urllib.request.urlopen(req, timeout=5)
+        assert len(srv) == 0
+    finally:
+        srv.stop()
+
+
+def test_horovod_env_prefers_am_rendezvous(identity, monkeypatch):
+    monkeypatch.setenv("TONY_AM_ADDR", "am-host:5000")
+    monkeypatch.setenv("TONY_HOROVOD_RENDEZVOUS_PORT", "7100")
+    env = make_runtime("horovod").build_env(identity, TonyConfig())
+    assert env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] == "am-host"
+    assert env["HOROVOD_GLOO_RENDEZVOUS_PORT"] == "7100"

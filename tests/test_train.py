@@ -154,6 +154,54 @@ def test_fit_pipeline_parallel_tiny_model():
     assert final["final_loss"] < 5.2
 
 
+def test_fit_pipeline_with_flash_attention():
+    """pp x flash: the pallas kernel runs region-local inside pipeline
+    stages (no nested shard_map — shardy forbids re-binding axes)."""
+    import dataclasses
+
+    cfg = FitConfig(
+        model=dataclasses.replace(
+            LlamaConfig.tiny(), n_layers=4, attention_impl="flash"
+        ),
+        data=DataConfig(global_batch=8, seq_len=32, vocab_size=256),
+        mesh_shape=MeshShape(pp=2, fsdp=2, tp=2),
+        pp_microbatches=4,
+        steps=6,
+        log_every=3,
+        lr=5e-3,
+        warmup_steps=2,
+    )
+    final = fit(cfg)
+    assert np.isfinite(final["final_loss"])
+
+
+def test_pipeline_rejects_sequence_parallel_attention():
+    """pp x ring/ulysses composes two manual shard_map regions, which the
+    partitioner cannot express — must fail loudly at build time."""
+    import dataclasses
+
+    import jax
+
+    from tony_tpu.parallel.mesh import build_mesh, set_default_mesh
+    from tony_tpu.parallel.sharding import DEFAULT_RULES
+    from tony_tpu.train.trainer import (
+        default_optimizer, make_train_state, make_train_step, pp_rules,
+    )
+
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), n_layers=4, attention_impl="ring"
+    )
+    mesh = build_mesh(MeshShape(pp=2, sp=2, fsdp=2))
+    set_default_mesh(mesh)
+    rules = pp_rules(DEFAULT_RULES)
+    opt = default_optimizer(warmup_steps=1, decay_steps=10)
+    state = make_train_state(jax.random.key(0), cfg, mesh, opt, rules)
+    step = make_train_step(cfg, mesh, opt, rules, n_microbatches=4)
+    tokens = np.random.default_rng(0).integers(0, 256, (8, 33))
+    with pytest.raises(NotImplementedError, match="ring"):
+        step(state, jnp.asarray(tokens[:, :-1]), jnp.asarray(tokens[:, 1:]))
+
+
 def test_fit_moe_expert_parallel_tiny_model():
     """EP is a first-class fit() axis: LlamaConfig.tiny_moe trains with the
     expert dim sharded over mesh_shape.ep."""

@@ -88,6 +88,9 @@ class ApplicationMaster(ApplicationRpcServicer):
         self._scheduler_mode = config.get_str(Keys.SCHEDULER_MODE, "GANG").upper()
         # serializes am.state.json writes (scheduler + supervise threads)
         self._am_state_write_lock = threading.Lock()
+        # gloo rendezvous store for horovod jobs (the reference's AM-side
+        # HorovodDriver, SURVEY.md section 3.4); started in run()
+        self._rendezvous = None
 
     # --- executor launch ----------------------------------------------------
 
@@ -105,6 +108,8 @@ class ApplicationMaster(ApplicationRpcServicer):
             "TONY_CONF_PATH": os.path.join(self.app_dir, "config.json"),
             **spec.env,
         }
+        if self._rendezvous is not None:
+            env["TONY_HOROVOD_RENDEZVOUS_PORT"] = str(self._rendezvous.port)
         log_path = os.path.join(
             self.app_dir, "logs", f"{spec.name}_{index}_attempt{attempt}.log"
         )
@@ -381,6 +386,11 @@ class ApplicationMaster(ApplicationRpcServicer):
             queue=self.config.get_str(Keys.APPLICATION_QUEUE, "default"),
             tags=self.config.get_list(Keys.APPLICATION_TAGS),
         )
+        if self.config.get_str(Keys.APPLICATION_FRAMEWORK) == "horovod":
+            from tony_tpu.runtime.horovod_driver import RendezvousServer
+
+            self._rendezvous = RendezvousServer().start()
+            log.info("horovod gloo rendezvous serving on :%d", self._rendezvous.port)
         self.backend.set_completion_callback(self._on_container_completed)
         self.backend.start()
         # The AM's own footprint consumes inventory, like a YARN AM container.
@@ -450,10 +460,7 @@ class ApplicationMaster(ApplicationRpcServicer):
                 # Only meaningful if this is still the task's current
                 # container and no result was reported (executor crash).
                 if task is not None and task.container_id == cid and task.state not in TERMINAL:
-                    self._finish_task(
-                        job_name, index, code if code != 0 else 0,
-                        pid_dead=authoritative,
-                    )
+                    self._finish_task(job_name, index, code, pid_dead=authoritative)
             self._check_heartbeats()
             if self._apply_failure_policy():
                 return
@@ -474,6 +481,19 @@ class ApplicationMaster(ApplicationRpcServicer):
             # channel died, code 255), the pid stays journalled: the remote
             # group may still be alive and must remain reapable.
             t.container_pid = 0
+        elif t is not None and t.container_pid:
+            # best-effort reap NOW, before any restart relaunches on this
+            # host — release() can't reach a group whose local channel
+            # already exited, and waiting for a future AM attempt would let
+            # a live orphan fight the replacement for the TPU devices
+            log.warning(
+                "non-authoritative exit for %s:%d; killing possible orphan "
+                "pg %d on %s", job_name, index, t.container_pid, t.host,
+            )
+            try:
+                self.backend.kill_orphan(t.host, t.container_pid)
+            except Exception:
+                log.exception("orphan kill failed (pid stays journalled)")
         self.events.emit(
             EventType.TASK_FINISHED,
             task=f"{job_name}:{index}",
@@ -590,6 +610,8 @@ class ApplicationMaster(ApplicationRpcServicer):
     def _teardown(self) -> None:
         self.scheduler.stop()
         self.backend.stop()
+        if self._rendezvous is not None:
+            self._rendezvous.stop()
         self.events.emit(
             EventType.APPLICATION_FINISHED,
             state=self.session.state.value,

@@ -240,20 +240,26 @@ class SshTransport:
         tar = subprocess.Popen(
             ["tar", "-C", src_dir, "-cf", "-", "."], stdout=subprocess.PIPE
         )
-        unpack = subprocess.run(
-            self._ssh + [
-                host,
-                f"mkdir -p {shlex.quote(dst_dir)} && "
-                f"tar -xpf - -C {shlex.quote(dst_dir)}",
-            ],
-            stdin=tar.stdout,
-            capture_output=True,
-            timeout=600,
-        )
-        tar.stdout.close()
-        if tar.wait() != 0 or unpack.returncode != 0:
+        try:
+            unpack = subprocess.run(
+                self._ssh + [
+                    host,
+                    f"mkdir -p {shlex.quote(dst_dir)} && "
+                    f"tar -xpf - -C {shlex.quote(dst_dir)}",
+                ],
+                stdin=tar.stdout,
+                capture_output=True,
+                timeout=600,
+            )
+        finally:
+            tar.stdout.close()
+            if tar.poll() is None:
+                tar.kill()  # a hung/timed-out unpack must not leak the child
+            tar_rc = tar.wait()
+        if tar_rc != 0 or unpack.returncode != 0:
             raise RuntimeError(
-                f"localization to {host}:{dst_dir} failed: "
+                f"localization to {host}:{dst_dir} failed "
+                f"(tar={tar_rc}, unpack={unpack.returncode}): "
                 f"{unpack.stderr.decode(errors='replace')[-500:]}"
             )
 

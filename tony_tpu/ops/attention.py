@@ -87,6 +87,18 @@ def _kv_index(b: int, heads: int, kv_heads: int) -> int:
     return (b // heads) * kv_heads + (b % heads) // rep
 
 
+def _out_struct(shape, dtype, *inputs) -> jax.ShapeDtypeStruct:
+    """Pallas out_shape carrying the inputs' varying-mesh-axes type: inside a
+    shard_map region (e.g. a pp pipeline stage) outputs must declare the vma
+    set or shard_map's type checker rejects the call."""
+    vma = frozenset()
+    for x in inputs:
+        vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_fwd(q, k, v, *, scale, blk_q, blk_k, causal, heads, kv_heads):
     """q: [B*heads, S, D], k/v: [B*kv_heads, S, D] ->
     (out [B*heads, S, D], lse [B*heads, 1, S] fp32)."""
@@ -103,8 +115,8 @@ def _flash_fwd(q, k, v, *, scale, blk_q, blk_k, causal, heads, kv_heads):
         in_specs=[qspec, kspec, kspec],
         out_specs=[qspec, rowspec],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+            _out_struct((BH, S, D), q.dtype, q, k, v),
+            _out_struct((BH, 1, S), jnp.float32, q, k, v),
         ],
         # out/lse blocks revisit the same index across the k-step dim
         compiler_params=pltpu.CompilerParams(
@@ -231,7 +243,7 @@ def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal, heads, kv_heads):
         grid=(BH, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=[qspec],
-        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)],
+        out_shape=[_out_struct((BH, S, D), q.dtype, q, k, v, g)],
         scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
@@ -257,8 +269,8 @@ def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal, heads, kv_heads):
         in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t],
         out_specs=[kspec_t, kspec_t],
         out_shape=[
-            jax.ShapeDtypeStruct((BKV, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BKV, S, D), v.dtype),
+            _out_struct((BKV, S, D), k.dtype, q, k, v, g),
+            _out_struct((BKV, S, D), v.dtype, q, k, v, g),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_k, D), jnp.float32),
@@ -361,8 +373,16 @@ def sharded_flash_attention(q, k, v, cfg=None, **kwargs) -> jax.Array:
     from tony_tpu.parallel.mesh import get_default_mesh
     from tony_tpu.parallel.sharding import attn_spec
 
+    from tony_tpu.parallel.mesh import inside_manual_region
+
     mesh = get_default_mesh()
     if mesh is None or mesh.size == 1:
+        return flash_attention(q, k, v, cfg, **kwargs)
+    if inside_manual_region():
+        # already inside a shard_map region (a pp pipeline stage): shardy
+        # cannot re-bind mesh axes in a nested manual computation, so run
+        # the kernel on the region-local data and let the outer partitioner
+        # own batch/heads (correct; may replicate the op across tp)
         return flash_attention(q, k, v, cfg, **kwargs)
     # GQA under tp: the heads axis is sharded over tp, so the narrower K/V
     # head dim must also divide tp — when it doesn't, fall back to expanding

@@ -86,6 +86,20 @@ def get_default_mesh() -> Mesh | None:
     return _DEFAULT_MESH
 
 
+def inside_manual_region() -> bool:
+    """True when tracing inside a shard_map manual computation (e.g. a pp
+    pipeline stage). A merely non-empty abstract mesh is NOT enough: a
+    ``jax.sharding.use_mesh`` context also sets one, with Auto/Explicit axis
+    types — only Manual axes mean an enclosing shard_map region that shardy
+    forbids re-binding collective axes inside."""
+    from jax.sharding import AxisType, get_abstract_mesh
+
+    mesh = get_abstract_mesh()
+    if mesh is None or not mesh.shape_tuple:
+        return False
+    return any(t == AxisType.Manual for t in mesh.axis_types)
+
+
 def build_mesh(shape: MeshShape | None = None, devices: list | None = None) -> Mesh:
     """Build a ``jax.sharding.Mesh`` with the canonical axis names.
 

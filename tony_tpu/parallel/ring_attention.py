@@ -99,12 +99,23 @@ def make_ring_attention(
     only if present in the mesh); the ring collective runs over ``axis_name``.
     Plugs into llama.LlamaConfig(attention_impl='ring') via set_default_mesh.
     """
+    from tony_tpu.parallel.mesh import inside_manual_region
     from tony_tpu.parallel.sharding import attn_spec
 
     spec = attn_spec(mesh, seq_axis=axis_name)
     inner = partial(ring_attention_local, axis_name=axis_name, causal=causal)
 
     def attn(q, k, v, cfg=None):
+        if inside_manual_region():
+            # shardy cannot re-bind collective axes inside a parent manual
+            # computation (tested: both full-manual and sp-only nesting are
+            # rejected by the sdy verifier) — pp_loss_from_pairs raises
+            # before reaching here; this guards direct shard_map users
+            raise NotImplementedError(
+                "ring attention cannot run inside another shard_map region "
+                "(e.g. a pp pipeline stage); use attention_impl='flash' or "
+                "'dot' with pp, or drop pp and shard the sequence with sp"
+            )
         return jax.shard_map(
             lambda a, b, c: inner(a, b, c),
             mesh=mesh,

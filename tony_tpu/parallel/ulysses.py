@@ -54,12 +54,19 @@ def ulysses_attention_local(
 
 def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "sp"):
     """AttnFn closure over full arrays (mirror of make_ring_attention)."""
+    from tony_tpu.parallel.mesh import inside_manual_region
     from tony_tpu.parallel.sharding import attn_spec
 
     spec = attn_spec(mesh, seq_axis=axis_name)
     inner = partial(ulysses_attention_local, axis_name=axis_name)
 
     def attn(q, k, v, cfg=None):
+        if inside_manual_region():
+            raise NotImplementedError(
+                "ulysses attention cannot run inside another shard_map "
+                "region (e.g. a pp pipeline stage); use attention_impl="
+                "'flash' or 'dot' with pp, or drop pp"
+            )
         return jax.shard_map(
             lambda a, b, c: inner(a, b, c),
             mesh=mesh,

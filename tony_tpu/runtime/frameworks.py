@@ -65,23 +65,31 @@ class PyTorchRuntime(Runtime):
 
 
 class HorovodRuntime(Runtime):
-    """Horovod env-contract parity, rendezvous-free.
+    """Horovod gloo env contract, backed by the AM's rendezvous store.
 
     The reference runs an AM-side python driver hosting a Gloo rendezvous
     server and exports HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT plus rank vars
-    (SURVEY.md section 3.4). Here the AM-assigned rank table already provides
-    everything the rendezvous would compute, so only the env contract
-    remains: HOROVOD_RANK/SIZE/LOCAL_*/CROSS_* plus controller/cpu-ops
-    selection. On TPU the ring-allreduce itself is replaced by lax.psum over
-    ICI (the BASELINE.json mapping), which needs no Horovod at all — this
-    adapter exists for migrating jobs still importing horovod in CPU mode.
+    (SURVEY.md section 3.4). Same shape here: the AM serves the gloo HTTP
+    KV store (runtime.horovod_driver.RendezvousServer) and advertises its
+    port via TONY_HOROVOD_RENDEZVOUS_PORT; rank/size come straight from the
+    AM rank table. On TPU the ring-allreduce itself is replaced by lax.psum
+    over ICI (the BASELINE.json mapping) — this adapter is the migration
+    lane for jobs still importing horovod in CPU/gloo mode.
     """
 
     name = "horovod"
 
     def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
+        import os
+
         env = super().build_env(identity, config)
+        # the rendezvous store lives on the AM; fall back to the coordinator
+        # address only if the AM didn't start one (no TONY_* env: unit tests)
+        am_host = os.environ.get("TONY_AM_ADDR", "").rpartition(":")[0]
+        rdv_port = os.environ.get("TONY_HOROVOD_RENDEZVOUS_PORT", "")
         host, _, port = identity.coordinator_address.rpartition(":")
+        if am_host and rdv_port:
+            host, port = am_host, rdv_port
         # one slot per container -> local size 1, cross size == world size
         env.update(
             {

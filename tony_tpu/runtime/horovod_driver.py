@@ -1,0 +1,97 @@
+"""AM-side Horovod gloo rendezvous server.
+
+The reference's HorovodDriver spawns a python process on the AM hosting the
+gloo rendezvous for workers (SURVEY.md section 3.4): gloo's HTTP store is a
+plain key/value server — clients PUT their connectivity info under a scope
+and poll GET until their peers' keys appear (a 404 means "not yet", the
+client retries until its timeout).
+
+This module is that server, stdlib-only so it also runs where horovod is not
+installed (rank/size themselves come from the AM rank table via the
+HOROVOD_* env, not from the store):
+
+    PUT /<scope>/<key>   store the body           -> 200
+    GET /<scope>/<key>   body if present          -> 200 | 404
+    DELETE /<scope>      drop a scope's keys      -> 200
+
+The ApplicationMaster starts it for framework == "horovod" jobs and exports
+TONY_HOROVOD_RENDEZVOUS_PORT into containers; HorovodRuntime points
+HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT at it.
+
+Security note: gloo clients speak plain unauthenticated HTTP, so this store
+cannot be behind the control plane's per-app token (the reference's horovod
+rendezvous server is equally open — protocol parity). Run horovod jobs on a
+trusted network segment; the store only exists for the job's lifetime.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class RendezvousServer:
+    """Threaded HTTP KV store speaking the gloo rendezvous protocol."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._store: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        store, lock = self._store, self._lock
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, body: bytes = b"") -> None:
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_PUT(self):  # noqa: N802 (stdlib casing)
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                with lock:
+                    store[self.path] = body
+                self._reply(200)
+
+            def do_GET(self):  # noqa: N802
+                with lock:
+                    body = store.get(self.path)
+                if body is None:
+                    self._reply(404)  # gloo polls until the key appears
+                else:
+                    self._reply(200, body)
+
+            def do_DELETE(self):  # noqa: N802
+                prefix = self.path.rstrip("/")
+                with lock:
+                    # scope-exact: /job1 must not wipe /job10's keys
+                    for key in [
+                        k for k in store
+                        if k == prefix or k.startswith(prefix + "/")
+                    ]:
+                        del store[key]
+                self._reply(200)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="hvd-rendezvous"
+        )
+
+    def start(self) -> "RendezvousServer":
+        self._thread.start()
+        return self
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+__all__ = ["RendezvousServer"]

@@ -180,6 +180,14 @@ def pp_loss_from_pairs(
 
     if cfg.is_moe:
         raise NotImplementedError("pp + MoE composition not supported yet")
+    if cfg.attention_impl in ("ring", "ulysses"):
+        # shardy cannot re-bind collective axes inside the pp-manual stage
+        # region (verifier rejects nested manual computations over sp)
+        raise NotImplementedError(
+            f"pp + attention_impl={cfg.attention_impl!r} is not supported: "
+            "sequence-parallel attention cannot nest inside pipeline stages; "
+            "use 'flash' or 'dot' with pp, or sp without pp"
+        )
     pp = int(mesh.shape["pp"])
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
