@@ -109,6 +109,19 @@ def test_horovod_rendezvous_kv_protocol():
         req = urllib.request.Request(f"{base}/job0", method="DELETE")
         urllib.request.urlopen(req, timeout=5)
         assert len(srv) == 0
+        # scope-exact delete: /job1 must not wipe /job10
+        for scope in ("job1", "job10"):
+            req = urllib.request.Request(
+                f"{base}/{scope}/rank0", data=b"x", method="PUT"
+            )
+            urllib.request.urlopen(req, timeout=5)
+        req = urllib.request.Request(f"{base}/job1", method="DELETE")
+        urllib.request.urlopen(req, timeout=5)
+        with urllib.request.urlopen(f"{base}/job10/rank0", timeout=5) as r:
+            assert r.read() == b"x"
+        # clear() (worker restart): everything 404s again
+        srv.clear()
+        assert len(srv) == 0
     finally:
         srv.stop()
 

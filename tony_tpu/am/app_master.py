@@ -572,6 +572,8 @@ class ApplicationMaster(ApplicationRpcServicer):
         for cid in cids:
             self.backend.release(cid)
         self.session.reset_for_restart(None)
+        if self._rendezvous is not None:
+            self._rendezvous.clear()  # stale peer info must 404 after restart
         self._write_am_state()
         self._drain_notifications()
         self.scheduler.schedule_all(self.specs)
@@ -596,6 +598,10 @@ class ApplicationMaster(ApplicationRpcServicer):
                 t.restarts += 1
                 t.last_heartbeat = 0.0
         log.warning("restarting %s", ", ".join(t.task_id for t in victims))
+        if self._rendezvous is not None:
+            # gloo rendezvous is all-or-nothing: even a failed_only restart
+            # must invalidate the store so every rank re-announces
+            self._rendezvous.clear()
         self._write_am_state()
         self.scheduler.schedule_all(self.specs)
 

@@ -370,10 +370,8 @@ def sharded_flash_attention(q, k, v, cfg=None, **kwargs) -> jax.Array:
     mesh keeps batch on dp/fsdp and heads on tp; the sequence dim stays local
     (flash needs full K/V — use attention_impl='ring' to shard sequence).
     """
-    from tony_tpu.parallel.mesh import get_default_mesh
+    from tony_tpu.parallel.mesh import get_default_mesh, inside_manual_region
     from tony_tpu.parallel.sharding import attn_spec
-
-    from tony_tpu.parallel.mesh import inside_manual_region
 
     mesh = get_default_mesh()
     if mesh is None or mesh.size == 1:

@@ -35,12 +35,19 @@ def pipeline_local(
     x: jax.Array,
     *,
     axis_name: str = "pp",
-) -> jax.Array:
+    with_aux: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Per-device GPipe schedule; call inside shard_map.
 
     ``x``: [M, mb, ...] microbatched input, replicated over the axis (only
     stage 0 reads it). Returns [M, mb, ...] outputs, replicated (the last
     stage's results are broadcast with a psum).
+
+    ``with_aux``: stage_fn returns ``(y, aux_scalar)`` (e.g. a MoE
+    load-balancing loss); real ticks' aux is accumulated per stage, summed
+    over stages with a psum, and averaged over microbatches — the result is
+    ``(out, aux)`` where aux matches the sequential trainer's
+    sum-over-layers, mean-over-batch scalar.
     """
     n_stages = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -50,6 +57,10 @@ def pipeline_local(
     # stage 0 never reads (it pulls from x), but keeps the perm a bijection.
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
+    def run_stage(params, batch):
+        result = stage_fn(params, batch)
+        return result if with_aux else (result, jnp.zeros((), jnp.float32))
+
     def probe_out():
         """Output structure for one microbatch (to size the buffers).
 
@@ -58,7 +69,7 @@ def pipeline_local(
         layer params would otherwise fail vma typing at trace time.
         """
         xin = jax.tree.map(lambda a: lax.pcast(a, (axis_name,), to="varying"), x[0])
-        return jax.eval_shape(lambda p, b: stage_fn(p, b), stage_params, xin)
+        return jax.eval_shape(lambda p, b: run_stage(p, b)[0], stage_params, xin)
 
     out_shape = probe_out()
     # pcast marks the zero buffers as device-varying along the pipeline axis
@@ -70,26 +81,35 @@ def pipeline_local(
     out0 = lax.pcast(
         jnp.zeros((M, *out_shape.shape), out_shape.dtype), (axis_name,), to="varying"
     )
+    aux0 = lax.pcast(jnp.zeros((), jnp.float32), (axis_name,), to="varying")
 
     def tick(t, carry):
-        recv, out = carry
+        recv, out, aux_acc = carry
         feed_idx = jnp.clip(t, 0, M - 1)
         first_stage_in = lax.dynamic_index_in_dim(x, feed_idx, 0, keepdims=False)
         first_stage_in = lax.pcast(
             first_stage_in.astype(recv.dtype), (axis_name,), to="varying"
         )
         cur = jnp.where(my == 0, first_stage_in, recv)
-        y = stage_fn(stage_params, cur)
+        y, aux = run_stage(stage_params, cur)
+        # stage s holds microbatch t-s at tick t; other ticks are warmup/
+        # drain garbage whose aux must not pollute the accumulator
+        valid = (t >= my) & (t - my < M)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
         updated = lax.dynamic_update_index_in_dim(out, y, out_idx, 0)
         out = jnp.where(t >= n_stages - 1, updated, out)
         recv = lax.ppermute(y, axis_name, perm)
-        return recv, out
+        return recv, out, aux_acc
 
-    _, out = lax.fori_loop(0, n_ticks, tick, (recv0, out0))
+    _, out, aux_acc = lax.fori_loop(0, n_ticks, tick, (recv0, out0, aux0))
     # Broadcast the last stage's buffer to every stage.
     out = jnp.where(my == n_stages - 1, out, jnp.zeros_like(out))
-    return lax.psum(out, axis_name)
+    out = lax.psum(out, axis_name)
+    if not with_aux:
+        return out
+    aux = lax.psum(aux_acc, axis_name) / M  # sum stages, mean microbatches
+    return out, aux
 
 
 def pipeline_apply(

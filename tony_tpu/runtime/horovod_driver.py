@@ -89,6 +89,13 @@ class RendezvousServer:
         with self._lock:
             return len(self._store)
 
+    def clear(self) -> None:
+        """Drop every key — called on worker restart so a relaunched gang
+        polls for FRESH peer info instead of reading the dead generation's
+        connectivity records."""
+        with self._lock:
+            self._store.clear()
+
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()

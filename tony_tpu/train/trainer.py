@@ -178,8 +178,6 @@ def pp_loss_from_pairs(
     """
     from tony_tpu.parallel.pipeline import microbatch, pipeline_local, unmicrobatch
 
-    if cfg.is_moe:
-        raise NotImplementedError("pp + MoE composition not supported yet")
     if cfg.attention_impl in ("ring", "ulysses"):
         # shardy cannot re-bind collective axes inside the pp-manual stage
         # region (verifier rejects nested manual computations over sp)
@@ -197,26 +195,33 @@ def pp_loss_from_pairs(
     xs = microbatch(x, n_microbatches)  # [M, mb, S, D]
 
     def body(stage_layers: Params, xs_: jax.Array, cos_: jax.Array, sin_: jax.Array):
-        def stage_fn(lp_stack: Params, mb: jax.Array) -> jax.Array:
-            def blk(h: jax.Array, lp: Params):
-                out, _ = llama.transformer_block(h, lp, cfg, cos_, sin_)
-                return out, None
+        def stage_fn(lp_stack: Params, mb: jax.Array):
+            def blk(carry, lp: Params):
+                h, aux_acc = carry
+                out, aux = llama.transformer_block(h, lp, cfg, cos_, sin_)
+                return (out, aux_acc + aux), None
 
             if cfg.remat:
                 blk = jax.checkpoint(
                     blk, policy=jax.checkpoint_policies.nothing_saveable
                 )
-            y, _ = jax.lax.scan(blk, mb, lp_stack)
-            return y
+            # the aux carry must be pp-varying like the stage's layer params
+            aux0 = jax.lax.pcast(
+                jnp.zeros((), jnp.float32), ("pp",), to="varying"
+            )
+            (y, aux), _ = jax.lax.scan(blk, (mb, aux0), lp_stack)
+            return y, aux
 
-        return pipeline_local(stage_fn, stage_layers, xs_, axis_name="pp")
+        return pipeline_local(
+            stage_fn, stage_layers, xs_, axis_name="pp", with_aux=True
+        )
 
     layer_specs = jax.tree.map(lambda _: P("pp"), params["layers"])
-    h = jax.shard_map(
+    h, aux = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(layer_specs, P(), P(), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={"pp"},  # manual over pp; all other axes stay auto
     )(params["layers"], xs, cos, sin)
     h = unmicrobatch(h)
@@ -225,4 +230,8 @@ def pp_loss_from_pairs(
     logits = (h @ params["lm_head"]).astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - tgt)
+    ce = jnp.mean(lse - tgt)
+    if cfg.is_moe:
+        # mirror loss_from_pairs: aux averaged over layers, scaled by coef
+        ce = ce + cfg.moe_aux_coef * aux / cfg.n_layers
+    return ce
