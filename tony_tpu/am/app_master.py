@@ -553,6 +553,15 @@ class ApplicationMaster(ApplicationRpcServicer):
             return True
         if self._restart_policy == "gang":
             self._gang_restart()
+        elif self._rendezvous is not None:
+            # gloo rendezvous is all-or-nothing: surviving ranks never
+            # re-announce, so restarting only the failed task would strand
+            # it polling forever — escalate to a full gang restart
+            log.warning(
+                "restart.policy=failed_only escalated to gang for the "
+                "horovod rendezvous contract"
+            )
+            self._gang_restart()
         else:  # failed_only
             self._restart_tasks({t.job_name for t in failed}, only_failed=True)
         return False
@@ -598,10 +607,6 @@ class ApplicationMaster(ApplicationRpcServicer):
                 t.restarts += 1
                 t.last_heartbeat = 0.0
         log.warning("restarting %s", ", ".join(t.task_id for t in victims))
-        if self._rendezvous is not None:
-            # gloo rendezvous is all-or-nothing: even a failed_only restart
-            # must invalidate the store so every rank re-announces
-            self._rendezvous.clear()
         self._write_am_state()
         self.scheduler.schedule_all(self.specs)
 

@@ -17,6 +17,7 @@ from tony_tpu.parallel.mesh import (
 )
 from tony_tpu.parallel.moe import MoEConfig, init_moe_params, moe_block
 from tony_tpu.parallel.pipeline import (
+    pipeline_train_1f1b,
     microbatch,
     pipeline_apply,
     pipeline_local,
@@ -46,6 +47,7 @@ __all__ = [
     "microbatch",
     "moe_block",
     "pipeline_apply",
+    "pipeline_train_1f1b",
     "pipeline_local",
     "ring_attention",
     "ring_attention_local",

@@ -18,11 +18,13 @@ stage, and x_microbatched has shape [M, mb, ...].
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -138,6 +140,209 @@ def pipeline_apply(
         in_specs=(param_specs, P()),
         out_specs=P(),
     )(stacked_stage_params, x)
+
+
+# --- 1F1B (memory-capped) training schedule ----------------------------------
+
+
+def pipeline_train_1f1b(
+    stage_fn: StageFn,
+    head_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    stage_params: Any,
+    head_params: Any,
+    xs: jax.Array,
+    targets: jax.Array,
+    *,
+    mesh: Mesh,
+    axis_name: str = "pp",
+):
+    """Pipelined training with 1F1B-style interleaving: loss with grads via
+    a hand-scheduled backward (jax.custom_vjp), O(P) activation memory.
+
+    GPipe under autodiff stores every microbatch's stage input until the
+    backward phase — O(M) live activations per stage. Here each global tick
+    runs one forward AND one backward slot per stage: microbatch i's forward
+    hits stage s at tick ``i + s`` and its backward at tick ``i + 2P-2 - s``,
+    so at most ``2P-1`` stage inputs are ever buffered (the eager variant of
+    PipeDream-flush/1F1B, arXiv:2104.04473: same flush bubble, constant
+    memory). The backward slot recomputes its stage forward from the saved
+    input (per-microbatch remat) inside ``jax.vjp``.
+
+    - ``stage_fn(stage_params, x_mb) -> y_mb`` — one microbatch through this
+      stage's layers (differentiable).
+    - ``head_fn(head_params, y_mb, tgt_mb) -> scalar`` — the per-microbatch
+      loss (final norm + lm head + CE); runs on the last stage only.
+    - ``xs``: [M, mb, ...] microbatched embedded inputs; ``targets``:
+      [M, mb, ...] microbatched labels.
+
+    Returns the scalar mean-over-microbatches loss. Gradients flow to
+    stage_params / head_params / xs through the custom VJP (targets get
+    zeros), so ``jax.value_and_grad`` over a loss built on this function
+    computes pipeline-parallel gradients without ever materialising the
+    GPipe activation tail.
+    """
+    return _pipeline_1f1b(
+        stage_params, head_params, xs, targets, stage_fn, head_fn, mesh, axis_name
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _pipeline_1f1b(stage_params, head_params, xs, targets,
+                   stage_fn, head_fn, mesh, axis_name):
+    loss, *_ = _run_1f1b(
+        stage_params, head_params, xs, targets, stage_fn, head_fn, mesh, axis_name
+    )
+    return loss
+
+
+def _pipeline_1f1b_fwd(stage_params, head_params, xs, targets,
+                       stage_fn, head_fn, mesh, axis_name):
+    loss, g_stage, g_head, dxs = _run_1f1b(
+        stage_params, head_params, xs, targets, stage_fn, head_fn, mesh, axis_name
+    )
+    return loss, (g_stage, g_head, dxs, targets.shape)
+
+
+def _pipeline_1f1b_bwd(stage_fn, head_fn, mesh, axis_name, res, g_loss):
+    g_stage, g_head, dxs, tgt_shape = res
+    scale = lambda t: jax.tree.map(lambda a: a * g_loss, t)  # noqa: E731
+    # integer targets take a float0 cotangent
+    dt = np.zeros(tgt_shape, jax.dtypes.float0)
+    return scale(g_stage), scale(g_head), scale(dxs), dt
+
+
+_pipeline_1f1b.defvjp(_pipeline_1f1b_fwd, _pipeline_1f1b_bwd)
+
+
+def _run_1f1b(stage_params, head_params, xs, targets,
+              stage_fn, head_fn, mesh, axis_name):
+    """The combined fwd+bwd schedule; returns (loss, stage_grads,
+    head_grads, dxs)."""
+    P_ = int(mesh.shape[axis_name])
+    M = xs.shape[0]
+    layer_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+
+    def body(sp, hp, xs_, tg_):
+        return _1f1b_local(
+            sp, hp, xs_, tg_, stage_fn=stage_fn, head_fn=head_fn,
+            axis_name=axis_name, n_stages=P_, M=M,
+        )
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P(), P()),
+        out_specs=(P(), layer_specs, P(), P()),
+        axis_names={axis_name},
+    )(stage_params, head_params, xs, targets)
+
+
+def _1f1b_local(stage_params, head_params, xs, targets, *,
+                stage_fn, head_fn, axis_name, n_stages, M):
+    my = lax.axis_index(axis_name)
+    # stage_params arrive pp-sharded on dim 0: each stage sees its own
+    # [L/P, ...] layer stack and stage_fn owns its interpretation (scan
+    # over it for a transformer; index [0] for one-param-per-stage)
+    sp_local = stage_params
+    n_ticks = M + 2 * n_stages - 2
+    buf_n = max(1, 2 * n_stages - 1)  # max in-flight inputs (stage 0)
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+    def vary(a):
+        # idempotent: zeros_like of pp-sharded params is already varying
+        vma = getattr(jax.typeof(a), "vma", frozenset()) or frozenset()
+        if axis_name in vma:
+            return a
+        return lax.pcast(a, (axis_name,), to="varying")
+
+    xin0 = jax.tree.map(vary, xs[0])
+    y_shape = jax.eval_shape(lambda p, b: stage_fn(p, b), sp_local, xin0)
+    # the head vjp must see VARYING head params: differentiating a varying
+    # computation w.r.t. an unvarying input makes jax insert an implicit
+    # psum over the axis (transpose of broadcast), which would sum the
+    # non-last stages' garbage head grads into the real ones
+    hp_var = jax.tree.map(vary, head_params)
+
+    recv_f0 = vary(jnp.zeros(y_shape.shape, y_shape.dtype))
+    recv_b0 = vary(jnp.zeros(y_shape.shape, y_shape.dtype))
+    inbuf0 = vary(jnp.zeros((buf_n, *xs.shape[1:]), xs.dtype))
+    g_stage0 = jax.tree.map(lambda a: vary(jnp.zeros_like(a)), sp_local)
+    g_head0 = jax.tree.map(lambda a: vary(jnp.zeros_like(a)), head_params)
+    dxs0 = vary(jnp.zeros_like(xs))
+    loss0 = vary(jnp.zeros((), jnp.float32))
+
+    last = n_stages - 1
+
+    def tick(t, carry):
+        recv_f, recv_b, inbuf, loss, g_stage, g_head, dxs = carry
+
+        # ---- forward slot: microbatch i_f enters this stage -------------
+        i_f = t - my
+        valid_f = (i_f >= 0) & (i_f < M)
+        idx_f = jnp.clip(i_f, 0, M - 1)
+        first_in = vary(
+            lax.dynamic_index_in_dim(xs, idx_f, 0, keepdims=False).astype(
+                recv_f.dtype
+            )
+        )
+        x_in = jnp.where(my == 0, first_in, recv_f)
+        inbuf = jnp.where(
+            valid_f,
+            lax.dynamic_update_index_in_dim(inbuf, x_in, idx_f % buf_n, 0),
+            inbuf,
+        )
+        y = stage_fn(sp_local, x_in)
+        send_f = lax.ppermute(y, axis_name, fwd_perm)
+
+        # ---- backward slot: microbatch i_b leaves this stage ------------
+        i_b = t - (2 * n_stages - 2 - my)
+        valid_b = (i_b >= 0) & (i_b < M)
+        idx_b = jnp.clip(i_b, 0, M - 1)
+        x_saved = lax.dynamic_index_in_dim(inbuf, idx_b % buf_n, 0, keepdims=False)
+        y_b, pull = jax.vjp(lambda p, a: stage_fn(p, a), sp_local, x_saved)
+
+        # last stage: seed the cotangent from the per-microbatch loss head
+        tgt = vary(lax.dynamic_index_in_dim(targets, idx_b, 0, keepdims=False))
+        loss_i, head_pull = jax.vjp(
+            lambda hp, a: head_fn(hp, a, tgt), hp_var, y_b
+        )
+        dhead_i, dy_head = head_pull(vary(jnp.asarray(1.0 / M, jnp.float32)))
+        mask_b = jnp.where(valid_b, 1.0, 0.0)
+        ct = jnp.where(my == last, dy_head.astype(y_b.dtype), recv_b)
+
+        dstage_i, dx_i = pull(ct)
+        g_stage = jax.tree.map(
+            lambda acc, gi: acc + gi * mask_b.astype(gi.dtype), g_stage, dstage_i
+        )
+        on_head = mask_b * jnp.where(my == last, 1.0, 0.0)
+        g_head = jax.tree.map(
+            lambda acc, gi: acc + gi * on_head.astype(gi.dtype), g_head, dhead_i
+        )
+        loss = loss + loss_i / M * on_head
+        # stage 0's input cotangent feeds the embedding backward
+        dxs = jnp.where(
+            valid_b & (my == 0),
+            lax.dynamic_update_index_in_dim(dxs, dx_i.astype(dxs.dtype), idx_b, 0),
+            dxs,
+        )
+        # the receiver uses this as a cotangent for ITS output (y dtype),
+        # mirroring the forward slot's first_in cast
+        send_b = lax.ppermute(dx_i.astype(recv_b.dtype), axis_name, bwd_perm)
+
+        return send_f, send_b, inbuf, loss, g_stage, g_head, dxs
+
+    _, _, _, loss, g_stage, g_head, dxs = lax.fori_loop(
+        0, n_ticks, tick,
+        (recv_f0, recv_b0, inbuf0, loss0, g_stage0, g_head0, dxs0),
+    )
+    # loss/head grads live on the last stage, dxs on stage 0: psum replicates
+    loss = lax.psum(loss, axis_name)
+    g_head = jax.tree.map(lambda a: lax.psum(a, axis_name), g_head)
+    dxs = lax.psum(dxs, axis_name)
+    # g_stage already has the local [L/P, ...] stack shape of the
+    # P(axis_name) out_spec
+    return loss, g_stage, g_head, dxs
 
 
 def microbatch(x: jax.Array, n_microbatches: int) -> jax.Array:

@@ -45,8 +45,11 @@ class FitConfig:
     lr: float = 3e-4
     warmup_steps: int = 100
     rules: Rules = field(default_factory=lambda: dict(DEFAULT_RULES))
-    # GPipe microbatches when mesh_shape.pp > 1 (0 -> 2 per stage)
+    # pipeline microbatches when mesh_shape.pp > 1 (0 -> 2 per stage)
     pp_microbatches: int = 0
+    # 'gpipe' (autodiff bwd, O(M) activations) | '1f1b' (interleaved
+    # hand-scheduled bwd, O(P) activations)
+    pp_schedule: str = "gpipe"
     # hook called every log_every steps with a metrics dict (obs -> AM push)
     on_metrics: Callable[[dict], None] | None = None
     resume: bool = True  # restore from checkpoint_dir if a checkpoint exists
@@ -104,7 +107,8 @@ def fit(cfg: FitConfig) -> dict:
         rules = pp_rules(rules)
     state = make_train_state(jax.random.key(0), cfg.model, mesh, optimizer, rules)
     step_fn = make_train_step(
-        cfg.model, mesh, optimizer, rules, n_microbatches=cfg.pp_microbatches
+        cfg.model, mesh, optimizer, rules,
+        n_microbatches=cfg.pp_microbatches, pp_schedule=cfg.pp_schedule,
     )
 
     manager = None

@@ -115,7 +115,7 @@ def make_train_state(
 def make_train_step(
     cfg: llama.LlamaConfig, mesh: Mesh,
     optimizer: optax.GradientTransformation, rules: Rules = DEFAULT_RULES,
-    *, n_microbatches: int = 0,
+    *, n_microbatches: int = 0, pp_schedule: str = "gpipe",
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted train step:
     ``(state, inputs[B,S], targets[B,S]) -> (state, metrics)``.
@@ -125,16 +125,26 @@ def make_train_step(
     Gradients are computed in the params' dtype (Adam's first moment is kept
     fp32 via mu_dtype); donation avoids a second copy of state.
 
-    A mesh with ``pp > 1`` selects the GPipe pipeline loss (layer stages over
-    the ``pp`` axis, ``n_microbatches`` microbatches — default 2 per stage);
-    the caller's rules must map "layers" to "pp" (fit() does this
-    automatically; :func:`pp_rules` applies the override).
+    A mesh with ``pp > 1`` selects a pipeline loss (layer stages over the
+    ``pp`` axis, ``n_microbatches`` microbatches — default 2 per stage):
+    ``pp_schedule='gpipe'`` (autodiff backward, O(M) activations) or
+    ``'1f1b'`` (hand-scheduled interleaved backward, O(P) activations —
+    raise n_microbatches freely to shrink the bubble). The caller's rules
+    must map "layers" to "pp" (fit() does this automatically;
+    :func:`pp_rules` applies the override).
     """
+    if pp_schedule not in ("gpipe", "1f1b"):
+        # validate even on pp=1 meshes: a typo'd schedule must fail loudly,
+        # not silently run the sequential loss
+        raise ValueError(
+            f"unknown pp_schedule {pp_schedule!r} (expected gpipe | 1f1b)"
+        )
     pp = int(mesh.shape.get("pp", 1))
     if pp > 1:
         rules = pp_rules(rules)
+        pp_loss = pp_loss_from_pairs if pp_schedule == "gpipe" else pp_1f1b_loss_from_pairs
         loss_fn = partial(
-            pp_loss_from_pairs, cfg=cfg, mesh=mesh,
+            pp_loss, cfg=cfg, mesh=mesh,
             n_microbatches=n_microbatches or 2 * pp,
         )
     else:
@@ -159,6 +169,92 @@ def make_train_step(
     )
 
 
+def pp_1f1b_loss_from_pairs(
+    params: Params, inputs: jax.Array, targets: jax.Array, *,
+    cfg: llama.LlamaConfig, mesh: Mesh, n_microbatches: int,
+) -> jax.Array:
+    """1F1B pipeline loss: same stage decomposition as the GPipe loss, but
+    the backward is hand-scheduled (parallel.pipeline.pipeline_train_1f1b)
+    with O(P) live activations instead of autodiff's O(M) — the loss head
+    (final norm + lm head + CE) moves INSIDE the last stage so each
+    microbatch's cotangent is seeded the moment its forward finishes.
+    """
+    from tony_tpu.parallel.pipeline import microbatch, pipeline_train_1f1b
+
+    if cfg.is_moe:
+        raise NotImplementedError(
+            "pp_schedule='1f1b' + MoE not supported (aux loss is not "
+            "threaded through the interleaved schedule); use 'gpipe'"
+        )
+    _pp_guard(cfg, mesh)
+
+    x = params["tok_emb"][inputs]
+    cos, sin = llama.rope_table(cfg, inputs.shape[1])
+    xs = microbatch(x, n_microbatches)
+    tgts = microbatch(targets, n_microbatches)
+
+    shared_stage = _pp_stage_fn(cfg, cos, sin)
+
+    def stage_fn(lp_stack: Params, mb: jax.Array) -> jax.Array:
+        return shared_stage(lp_stack, mb)[0]  # dense: aux is always 0
+
+    def head_fn(hp: Params, y: jax.Array, tgt: jax.Array) -> jax.Array:
+        return _ce_head(hp["final_norm"], hp["lm_head"], y, tgt, cfg)
+
+    head_params = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+    return pipeline_train_1f1b(
+        stage_fn, head_fn, params["layers"], head_params, xs, tgts, mesh=mesh
+    )
+
+
+def _pp_guard(cfg: llama.LlamaConfig, mesh: Mesh) -> None:
+    if cfg.attention_impl in ("ring", "ulysses"):
+        # shardy cannot re-bind collective axes inside the pp-manual stage
+        # region (verifier rejects nested manual computations over sp)
+        raise NotImplementedError(
+            f"pp + attention_impl={cfg.attention_impl!r} is not supported: "
+            "sequence-parallel attention cannot nest inside pipeline stages; "
+            "use 'flash' or 'dot' with pp, or sp without pp"
+        )
+    pp = int(mesh.shape["pp"])
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+
+
+def _ce_head(final_norm: jax.Array, lm_head: jax.Array, h: jax.Array,
+             targets: jax.Array, cfg: llama.LlamaConfig) -> jax.Array:
+    """final norm + lm head + mean cross-entropy — the ONE copy both
+    pipeline schedules share (llama.loss_from_pairs keeps the model-level
+    equivalent so the model stays importable without the trainer)."""
+    h = llama.rms_norm(h, final_norm, cfg.norm_eps)
+    logits = (h @ lm_head).astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    sel = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - sel)
+
+
+def _pp_stage_fn(cfg: llama.LlamaConfig, cos: jax.Array, sin: jax.Array):
+    """One pipeline stage: scan this stage's [L/P] layer stack over a
+    microbatch, returning (y, summed aux). Shared by both schedules."""
+
+    def stage_fn(lp_stack: Params, mb: jax.Array):
+        def blk(carry, lp: Params):
+            h, aux_acc = carry
+            out, aux = llama.transformer_block(h, lp, cfg, cos, sin)
+            return (out, aux_acc + aux), None
+
+        if cfg.remat:
+            blk = jax.checkpoint(
+                blk, policy=jax.checkpoint_policies.nothing_saveable
+            )
+        # the aux carry must be pp-varying like the stage's layer params
+        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
+        (y, aux), _ = jax.lax.scan(blk, (mb, aux0), lp_stack)
+        return y, aux
+
+    return stage_fn
+
+
 def pp_rules(rules: Rules = DEFAULT_RULES) -> Rules:
     """Rules for pipeline training: the stacked-layer dim becomes the stage
     dim, sharded over ``pp`` (each stage owns n_layers/pp layers)."""
@@ -178,42 +274,16 @@ def pp_loss_from_pairs(
     """
     from tony_tpu.parallel.pipeline import microbatch, pipeline_local, unmicrobatch
 
-    if cfg.attention_impl in ("ring", "ulysses"):
-        # shardy cannot re-bind collective axes inside the pp-manual stage
-        # region (verifier rejects nested manual computations over sp)
-        raise NotImplementedError(
-            f"pp + attention_impl={cfg.attention_impl!r} is not supported: "
-            "sequence-parallel attention cannot nest inside pipeline stages; "
-            "use 'flash' or 'dot' with pp, or sp without pp"
-        )
-    pp = int(mesh.shape["pp"])
-    if cfg.n_layers % pp:
-        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    _pp_guard(cfg, mesh)
 
     x = params["tok_emb"][inputs]
     cos, sin = llama.rope_table(cfg, inputs.shape[1])
     xs = microbatch(x, n_microbatches)  # [M, mb, S, D]
 
     def body(stage_layers: Params, xs_: jax.Array, cos_: jax.Array, sin_: jax.Array):
-        def stage_fn(lp_stack: Params, mb: jax.Array):
-            def blk(carry, lp: Params):
-                h, aux_acc = carry
-                out, aux = llama.transformer_block(h, lp, cfg, cos_, sin_)
-                return (out, aux_acc + aux), None
-
-            if cfg.remat:
-                blk = jax.checkpoint(
-                    blk, policy=jax.checkpoint_policies.nothing_saveable
-                )
-            # the aux carry must be pp-varying like the stage's layer params
-            aux0 = jax.lax.pcast(
-                jnp.zeros((), jnp.float32), ("pp",), to="varying"
-            )
-            (y, aux), _ = jax.lax.scan(blk, (mb, aux0), lp_stack)
-            return y, aux
-
         return pipeline_local(
-            stage_fn, stage_layers, xs_, axis_name="pp", with_aux=True
+            _pp_stage_fn(cfg, cos_, sin_), stage_layers, xs_,
+            axis_name="pp", with_aux=True,
         )
 
     layer_specs = jax.tree.map(lambda _: P("pp"), params["layers"])
@@ -226,11 +296,7 @@ def pp_loss_from_pairs(
     )(params["layers"], xs, cos, sin)
     h = unmicrobatch(h)
 
-    h = llama.rms_norm(h, params["final_norm"], cfg.norm_eps)
-    logits = (h @ params["lm_head"]).astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    ce = jnp.mean(lse - tgt)
+    ce = _ce_head(params["final_norm"], params["lm_head"], h, targets, cfg)
     if cfg.is_moe:
         # mirror loss_from_pairs: aux averaged over layers, scaled by coef
         ce = ce + cfg.moe_aux_coef * aux / cfg.n_layers
