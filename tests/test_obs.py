@@ -172,3 +172,36 @@ def test_secure_job_end_to_end(tmp_path):
     client = TonyClient(cfg)
     assert client.run(quiet=True) == 0
     assert (tmp_path / client.app_id / "app.token").exists()
+
+
+def test_diagnostics_context(monkeypatch, tmp_path):
+    """diagnostics.enabled -> TONY_TPU_DIAGNOSTICS -> a real
+    cloud-tpu-diagnostics stack-trace context around fit()."""
+    import contextlib
+
+    from tony_tpu.obs.diagnostics import diagnostics_context
+
+    # off by default: nullcontext
+    monkeypatch.delenv("TONY_TPU_DIAGNOSTICS", raising=False)
+    assert isinstance(diagnostics_context(), contextlib.nullcontext)
+    # on: the REAL library context (not the fallback nullcontext — this
+    # image ships cloud-tpu-diagnostics and the glue must actually engage);
+    # 1s interval so the collection daemon joins promptly at exit
+    monkeypatch.setenv("TONY_TPU_DIAGNOSTICS", "1")
+    monkeypatch.setenv("TONY_TPU_DIAGNOSTICS_INTERVAL_S", "1")
+    ctx = diagnostics_context()
+    assert not isinstance(ctx, contextlib.nullcontext)
+    with ctx:
+        pass
+    # env glue: the runtime exports the flag from the config key
+    from tony_tpu.config.config import TonyConfig
+    from tony_tpu.runtime import make_runtime
+    from tony_tpu.runtime.base import TaskIdentity
+
+    cfg = TonyConfig({"diagnostics.enabled": True})
+    ident = TaskIdentity(
+        job_name="worker", index=0, cluster_spec={"worker": ["h:1"]},
+        coordinator_address="h:1", process_id=0, num_processes=1, generation=0,
+    )
+    env = make_runtime("generic").build_env(ident, cfg)
+    assert env.get("TONY_TPU_DIAGNOSTICS") == "1"

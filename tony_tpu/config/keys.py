@@ -53,6 +53,8 @@ class Keys:
     METRICS_ENABLED = "metrics.enabled"
     PROFILER_ENABLED = "profiler.enabled"
     PROFILER_PORT = "profiler.port"
+    # cloud-tpu-diagnostics periodic stack traces (wedged-job debugging)
+    DIAGNOSTICS_ENABLED = "diagnostics.enabled"
 
     # --- cluster backend ---
     # Deliberate non-goals vs the reference key surface: docker keys (no
@@ -132,6 +134,7 @@ DEFAULTS: dict[str, object] = {
     Keys.METRICS_ENABLED: True,
     Keys.PROFILER_ENABLED: False,
     Keys.PROFILER_PORT: 9999,
+    Keys.DIAGNOSTICS_ENABLED: False,
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",

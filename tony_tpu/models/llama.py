@@ -394,6 +394,12 @@ def _remat_policy(name: str):
         "save_attn_kernel_gate": jax.checkpoint_policies.save_only_these_names(
             "attn_qkv", "flash_res", "ffn_gate"
         ),
+        # flash residuals + gate but NOT q/k/v: bwd re-runs the (cheap) qkv
+        # projections but skips the flash fwd kernel and the two widest FFN
+        # matmuls — 0.8GB less HBM than save_attn_kernel_gate
+        "save_flash_gate": jax.checkpoint_policies.save_only_these_names(
+            "flash_res", "ffn_gate"
+        ),
         "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         "checkpoint_dots": jax.checkpoint_policies.checkpoint_dots,
     }

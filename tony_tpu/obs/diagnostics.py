@@ -1,0 +1,54 @@
+"""cloud-tpu-diagnostics glue: stack traces out of hung/faulted TPU jobs.
+
+SURVEY.md section 5 ("Metrics/observability": replace nvidia-smi with
+libtpu/cloud-tpu-diagnostics): the library periodically collects per-thread
+Python stack traces to /tmp/debugging (and optionally Cloud Logging), which
+is exactly what you want from a wedged collective or a host stuck in a gang
+barrier. Opt in per job with ``diagnostics.enabled = true``; the executor
+exports TONY_TPU_DIAGNOSTICS and fit() wraps training in this context.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+
+def diagnostics_context():
+    """Context manager wrapping a training run; nullcontext unless the job
+    opted in (TONY_TPU_DIAGNOSTICS env) and the library is importable."""
+    if not os.environ.get("TONY_TPU_DIAGNOSTICS"):
+        return contextlib.nullcontext()
+    try:
+        from cloud_tpu_diagnostics import diagnostic
+        from cloud_tpu_diagnostics.configuration import (
+            debug_configuration,
+            diagnostic_configuration,
+            stack_trace_configuration,
+        )
+
+        # NOTE: the library's collection daemon sleeps this whole interval
+        # between dumps and clean exit joins it — keep it modest so a
+        # finished job doesn't hang in teardown
+        interval = int(os.environ.get("TONY_TPU_DIAGNOSTICS_INTERVAL_S", "60"))
+        config = diagnostic_configuration.DiagnosticConfig(
+            debug_config=debug_configuration.DebugConfig(
+                stack_trace_config=stack_trace_configuration.StackTraceConfig(
+                    collect_stack_trace=True,
+                    stack_trace_to_cloud=False,  # zero-egress: local dir only
+                    stack_trace_interval_seconds=interval,
+                )
+            )
+        )
+        log.info("cloud-tpu-diagnostics stack-trace collection enabled")
+        return diagnostic.diagnose(config)
+    except Exception:
+        log.warning("cloud-tpu-diagnostics unavailable; continuing without",
+                    exc_info=True)
+        return contextlib.nullcontext()
+
+
+__all__ = ["diagnostics_context"]

@@ -18,7 +18,6 @@ stage, and x_microbatched has shape [M, mb, ...].
 
 from __future__ import annotations
 
-import functools
 from functools import partial
 from typing import Any, Callable
 
@@ -186,7 +185,7 @@ def pipeline_train_1f1b(
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _pipeline_1f1b(stage_params, head_params, xs, targets,
                    stage_fn, head_fn, mesh, axis_name):
     loss, *_ = _run_1f1b(
@@ -358,4 +357,7 @@ def unmicrobatch(x: jax.Array) -> jax.Array:
     return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
 
 
-__all__ = ["microbatch", "pipeline_apply", "pipeline_local", "unmicrobatch"]
+__all__ = [
+    "microbatch", "pipeline_apply", "pipeline_local",
+    "pipeline_train_1f1b", "unmicrobatch",
+]

@@ -86,6 +86,9 @@ class Runtime:
         # the executor exports the intent and fit() starts it.
         if config.get_bool(Keys.PROFILER_ENABLED, False):
             env["TONY_PROFILER_PORT"] = str(config.get_int(Keys.PROFILER_PORT, 9999))
+        # stack-trace collection for wedged jobs (obs.diagnostics glue)
+        if config.get_bool(Keys.DIAGNOSTICS_ENABLED, False):
+            env["TONY_TPU_DIAGNOSTICS"] = "1"
         return env
 
     def needs_data_port(self) -> bool:

@@ -72,6 +72,13 @@ class FitConfig:
 
 def fit(cfg: FitConfig) -> dict:
     """Run the training loop to cfg.steps; returns final metrics."""
+    from tony_tpu.obs.diagnostics import diagnostics_context
+
+    with diagnostics_context():
+        return _fit(cfg)
+
+
+def _fit(cfg: FitConfig) -> dict:
     jax_tpu.initialize()  # no-op outside a tony-tpu job
     cfg.apply_job_env()
     if os.environ.get("TONY_PROFILER_PORT"):
