@@ -22,6 +22,15 @@ def diagnostics_context():
     opted in (TONY_TPU_DIAGNOSTICS env) and the library is importable."""
     if not os.environ.get("TONY_TPU_DIAGNOSTICS"):
         return contextlib.nullcontext()
+    raw_interval = os.environ.get("TONY_TPU_DIAGNOSTICS_INTERVAL_S", "60")
+    try:
+        interval = int(raw_interval)
+    except ValueError:
+        log.warning(
+            "TONY_TPU_DIAGNOSTICS_INTERVAL_S=%r is not an integer; using 60",
+            raw_interval,
+        )
+        interval = 60
     try:
         from cloud_tpu_diagnostics import diagnostic
         from cloud_tpu_diagnostics.configuration import (
@@ -30,10 +39,9 @@ def diagnostics_context():
             stack_trace_configuration,
         )
 
-        # NOTE: the library's collection daemon sleeps this whole interval
+        # NOTE: the library's collection daemon sleeps the whole interval
         # between dumps and clean exit joins it — keep it modest so a
         # finished job doesn't hang in teardown
-        interval = int(os.environ.get("TONY_TPU_DIAGNOSTICS_INTERVAL_S", "60"))
         config = diagnostic_configuration.DiagnosticConfig(
             debug_config=debug_configuration.DebugConfig(
                 stack_trace_config=stack_trace_configuration.StackTraceConfig(
