@@ -170,6 +170,29 @@ def gqa_kernel_bench(steps: int = 8) -> dict:
     return out
 
 
+def long_context_bench(steps: int = 4) -> dict:
+    """Single-chip S=32768 flash attention fwd+bwd — the long-context axis
+    the reference never had. 1.07TB of fp32 scores per layer if
+    materialised; the kernel streams them through VMEM."""
+    from tony_tpu.ops.attention import flash_attention
+
+    B, S, H, D = 1, 32768, 8, 128
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
+    reps = 2
+    fwd = 4 * B * H * S * S * D / 2
+    flops = 3.5 * fwd * reps
+
+    r = _timed_scan_grad(
+        lambda a: flash_attention(a, k, v, causal=True), q, reps=reps, steps=steps
+    )
+    if "ms" in r:
+        r["tflops"] = round(flops / (r["ms"] / 1e3) / 1e12, 1)
+    return r
+
+
 def flash_matches_dot_on_tpu() -> bool:
     """Correctness of the Pallas kernels on REAL hardware (the CPU suite
     runs them in interpreter mode only)."""
@@ -227,6 +250,7 @@ def run_bench() -> dict:
         extra["flash_matches_dot_on_tpu"] = f"{type(e).__name__}: {str(e)[:120]}"
     extra["attn_kernel_s8192"] = kernel_bench_s8192()
     extra["gqa_kernel_32_8"] = gqa_kernel_bench()
+    extra["flash_s32768"] = long_context_bench()
     try:
         # 4 experts (~1.2B total / ~700M active): the 8-expert preset's
         # AdamW state alone exceeds the chip's 16GB

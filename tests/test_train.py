@@ -175,6 +175,27 @@ def test_fit_pipeline_with_flash_attention():
     assert np.isfinite(final["final_loss"])
 
 
+def test_fit_pipeline_1f1b_schedule():
+    """pp_schedule='1f1b' is a first-class fit() knob: the interleaved
+    backward trains end to end and the loss decreases."""
+    import dataclasses
+
+    cfg = FitConfig(
+        model=dataclasses.replace(LlamaConfig.tiny(), n_layers=4),
+        data=DataConfig(global_batch=8, seq_len=32, vocab_size=256),
+        mesh_shape=MeshShape(pp=2, fsdp=2, tp=2),
+        pp_microbatches=4,
+        pp_schedule="1f1b",
+        steps=30,
+        log_every=15,
+        lr=5e-3,
+        warmup_steps=2,
+    )
+    final = fit(cfg)
+    assert np.isfinite(final["final_loss"])
+    assert final["final_loss"] < 5.2
+
+
 def test_pipeline_rejects_sequence_parallel_attention():
     """pp x ring/ulysses composes two manual shard_map regions, which the
     partitioner cannot express — must fail loudly at build time."""
