@@ -172,8 +172,8 @@ def gqa_kernel_bench(steps: int = 8) -> dict:
 
 def long_context_bench(steps: int = 4) -> dict:
     """Single-chip S=32768 flash attention fwd+bwd — the long-context axis
-    the reference never had. 1.07TB of fp32 scores per layer if
-    materialised; the kernel streams them through VMEM."""
+    the reference never had. 34GB of fp32 scores per layer (32768^2 x 4B x
+    8 heads) if materialised; the kernel streams them through VMEM."""
     from tony_tpu.ops.attention import flash_attention
 
     B, S, H, D = 1, 32768, 8, 128
