@@ -1,0 +1,64 @@
+"""Generate docs/CONFIG.md from the config key registry (single source of
+truth: tony_tpu/config/keys.py). Re-run after adding keys."""
+
+import inspect
+import os
+import re
+
+from tony_tpu.config import keys as K
+
+
+def main() -> None:
+    src = inspect.getsource(K.Keys)
+    lines = ["# Configuration reference", "",
+             "Generated from `tony_tpu/config/keys.py` by "
+             "`scripts/gen_config_doc.py` — do not edit by hand.",
+             "",
+             "Layering (low to high precedence): baked defaults → TOML file "
+             "→ `-D key=value` CLI overrides → `TONY_CONF_section__key` env.",
+             "", "| key | default | notes |", "|---|---|---|"]
+    comment = []
+    for raw in src.splitlines():
+        line = raw.strip()
+        if line.startswith("#"):
+            text = line.lstrip("# ")
+            if not text.startswith("---"):  # skip section markers
+                comment.append(text)
+            continue
+        m = re.match(r'([A-Z_]+) = "([^"]+)"(?:\s*#\s*(.*))?', line)
+        if not m:
+            if not line:
+                comment = []
+            continue
+        attr, key, inline = m.groups()
+        default = K.DEFAULTS.get(key, "—")
+        if default == "":
+            default = '""'
+        note = (inline or " ".join(comment)).replace("|", "\\|")
+        comment = []
+        lines.append(f"| `{key}` | `{default}` | {note} |")
+    lines += ["",
+              "## Per-jobtype keys (`job.<type>.*`)", "",
+              "| suffix | meaning |", "|---|---|"]
+    suffix_doc = {
+        "instances": "container count for this task type",
+        "memory_mb": "per-container memory ask",
+        "cpus": "per-container vcores",
+        "tpu_chips": "per-container TPU chips (the yarn.io/gpu analogue)",
+        "command": "the user process to exec",
+        "env": "extra env (`[\"K=V\", ...]` or table)",
+        "depends_on": "launch gating on another task type",
+        "depends_timeout_s": "dependency wait budget",
+        "untracked": "excluded from job status (e.g. tensorboard)",
+        "node_label": "placement constraint (RemoteBackend host labels)",
+    }
+    for s in K.JOB_SUFFIXES:
+        lines.append(f"| `{s}` | {suffix_doc.get(s, '')} |")
+    out = os.path.join(os.path.dirname(__file__), "..", "docs", "CONFIG.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {os.path.abspath(out)} ({len(lines)} lines)")
+
+
+if __name__ == "__main__":
+    main()

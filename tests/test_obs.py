@@ -76,6 +76,12 @@ class TestPortal:
             detail = PortalData(str(fake_app)).job("job-1")
             latest = _latest_metrics(detail["events"])
             assert latest["worker:0"]["mfu"] == 0.52  # superseded 0.41 gone
+            # history charts: a sparkline polyline per charted metric
+            assert "<svg" in body and "polyline" in body
+            from tony_tpu.obs.portal import _metric_series
+
+            series = _metric_series(detail["events"])
+            assert series["worker:0"]["mfu"] == [0.41, 0.52]
             status, body = get("/job/job-1/log/worker_0_attempt0.log")
             assert status == 200 and body == "hello log\n"
             with pytest.raises(urllib.error.HTTPError):

@@ -470,3 +470,47 @@ def test_pp_1f1b_memory_is_microbatch_independent():
 
     gpipe, one_f1b = temp_mb(pp_loss_from_pairs), temp_mb(pp_1f1b_loss_from_pairs)
     assert one_f1b < gpipe / 5, (gpipe, one_f1b)
+
+
+@pytest.mark.parametrize("preset", ["llama2_7b", "llama3_8b"])
+def test_real_model_shardings_resolve_on_8dev_mesh(preset):
+    """The REAL 7B/8B configs' parameter AND optimizer-state shardings
+    resolve on an fsdp4 x tp2 mesh without materialising anything: every
+    named dim divides its mesh axes (catches head/ffn/vocab divisibility
+    breaks and regressions in the opt-state path-suffix matching)."""
+    import jax
+
+    from tony_tpu.models.llama import LlamaConfig, logical_axes
+    from tony_tpu.parallel.sharding import tree_shardings
+    from tony_tpu.train.trainer import default_optimizer, state_shardings
+
+    import functools
+
+    import numpy as _np
+
+    from tony_tpu.models import llama as _llama
+
+    cfg = getattr(LlamaConfig, preset)()
+    mesh = build_mesh(MeshShape(fsdp=4, tp=2))
+    opt = default_optimizer()
+    shardings = state_shardings(cfg, mesh, opt)
+
+    def check(shapes_tree, shards_tree, what):
+        flat_shapes = jax.tree.leaves(shapes_tree)
+        flat_shards = jax.tree.leaves(shards_tree)
+        assert len(flat_shapes) == len(flat_shards), what
+        for leaf, shard in zip(flat_shapes, flat_shards):
+            for dim, names in zip(leaf.shape, shard.spec + (None,) * 10):
+                if names is None:
+                    continue
+                axes = names if isinstance(names, tuple) else (names,)
+                factor = int(_np.prod([mesh.shape[a] for a in axes]))
+                assert dim % factor == 0, (preset, what, leaf.shape, shard.spec)
+
+    params_shape = jax.eval_shape(
+        functools.partial(_llama.init_params, cfg=cfg), jax.random.key(0)
+    )
+    check(params_shape, shardings.params, "params")
+    # the optimizer state (Adam mu/nu, matched by path suffix) must divide too
+    opt_shape = jax.eval_shape(opt.init, params_shape)
+    check(opt_shape, shardings.opt_state, "opt_state")

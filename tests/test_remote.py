@@ -305,3 +305,70 @@ def test_e2e_remote_backend_localization(tmp_path):
         apps = os.listdir(root / host)
         assert len(apps) == 1
         assert os.path.isfile(root / host / apps[0] / "config.json")
+
+
+@pytest.mark.slow
+def test_e2e_remote_localized_elastic_resume(tmp_path):
+    """The pod-slice production story in one test: RemoteBackend + per-host
+    localization (no shared-FS assumption for the app dir) + a real fit()
+    job that dies mid-training, gang-restarts, and resumes from the last
+    orbax checkpoint."""
+    import sys
+
+    root = tmp_path / "localized"
+    ckpt = tmp_path / "ckpt"  # checkpoints themselves stay on a shared path
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "train.py").write_text(
+        "import logging, os\n"
+        "logging.basicConfig(level=logging.INFO)\n"
+        "from tony_tpu.train import fit, FitConfig\n"
+        "from tony_tpu.train.data import DataConfig\n"
+        "from tony_tpu.models.llama import LlamaConfig\n"
+        "assert os.environ['TONY_APP_DIR'].startswith(%r), os.environ['TONY_APP_DIR']\n"
+        "gen = os.environ.get('TONY_GENERATION', '0')\n"
+        "ck = os.environ['TONY_CHECKPOINT_DIR']\n"
+        "def durable():\n"
+        "    return os.path.isdir(ck) and any(d.isdigit() for d in os.listdir(ck))\n"
+        "def maybe_crash(m):\n"
+        "    if gen == '0' and m['step'] >= 4 and durable():\n"
+        "        os._exit(1)\n"
+        "out = fit(FitConfig(model=LlamaConfig.tiny(),\n"
+        "    data=DataConfig(global_batch=8, seq_len=32, vocab_size=128),\n"
+        "    steps=8, log_every=1, on_metrics=maybe_crash))\n"
+        "print('TRAINING DONE', out)\n" % str(root)
+    )
+    code, app_dir = submit_remote(
+        tmp_path,
+        {
+            "application.name": "remote-elastic",
+            "application.framework": "jax",
+            "application.timeout_s": 240,
+            "cluster.localize": True,
+            "cluster.localize_root": str(root),
+            "restart.policy": "gang",
+            "restart.max_worker_restarts": 2,
+            "checkpoint.dir": str(ckpt),
+            "checkpoint.interval_steps": 2,
+            "job.worker.instances": 1,
+            "job.worker.command": f"{sys.executable} train.py",
+            "job.worker.env": ["JAX_PLATFORMS=cpu"],
+        },
+        src_dir=str(src),
+    )
+    logs_dir = os.path.join(app_dir, "logs")
+    if code != 0:
+        for n in sorted(os.listdir(logs_dir)):
+            print(f"===== {n}",
+                  open(os.path.join(logs_dir, n), errors="replace").read()[-2000:])
+    assert code == 0
+    attempt1 = [n for n in os.listdir(logs_dir) if "attempt1" in n]
+    assert attempt1, os.listdir(logs_dir)
+    text = open(os.path.join(logs_dir, attempt1[0]), errors="replace").read()
+    assert "resumed from checkpoint step" in text
+    assert "TRAINING DONE" in text
+    # the localized copy was actually used (per-host dir exists with src)
+    hosts = os.listdir(root)
+    assert hosts
+    app = os.listdir(root / hosts[0])[0]
+    assert os.path.isfile(root / hosts[0] / app / "src" / "train.py")

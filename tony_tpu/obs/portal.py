@@ -138,6 +138,65 @@ def _latest_metrics(events: list[dict]) -> dict[str, dict]:
     return latest
 
 
+def _metric_series(events: list[dict]) -> dict[str, dict[str, list[float]]]:
+    """task -> metric name -> time-ordered values (for the charts)."""
+    import math
+
+    series: dict[str, dict[str, list[float]]] = {}
+    for e in events:
+        if e.get("type") == "METRICS" and isinstance(e.get("samples"), dict):
+            per_task = series.setdefault(str(e.get("task", "?")), {})
+            for name, value in e["samples"].items():
+                # bools would chart as 0/1; NaN/Inf (a diverged loss — the
+                # moment the operator opens this page) would poison the
+                # polyline's min/max into an invisible chart
+                if (isinstance(value, (int, float))
+                        and not isinstance(value, bool)
+                        and math.isfinite(value)):
+                    per_task.setdefault(name, []).append(float(value))
+    return series
+
+
+def _sparkline(values: list[float], w: int = 160, h: int = 28) -> str:
+    """Inline SVG polyline — the portal's metrics chart (the reference
+    renders utilisation charts from its history events the same way)."""
+    if len(values) < 2:
+        return ""
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    pts = " ".join(
+        f"{2 + i * (w - 4) / (len(values) - 1):.1f},"
+        f"{h - 2 - (v - lo) / span * (h - 4):.1f}"
+        for i, v in enumerate(values)
+    )
+    return (
+        f"<svg width='{w}' height='{h}' viewBox='0 0 {w} {h}'>"
+        f"<polyline points='{pts}' fill='none' stroke='#36c' stroke-width='1.5'/>"
+        f"</svg>"
+    )
+
+
+def _charts_html(series: dict[str, dict[str, list[float]]]) -> str:
+    chart_metrics = ["tokens_per_sec", "mfu", "loss", "rss_mb", "hbm_mb"]
+    rows = ""
+    for task in sorted(series):
+        cells = ""
+        for m in chart_metrics:
+            values = series[task].get(m, [])
+            svg = _sparkline(values)
+            if svg:
+                cells += (
+                    f"<td>{html.escape(m)}<br>{svg}<br>"
+                    f"<small>{_fmt_num(values[0])} → {_fmt_num(values[-1])}"
+                    f"</small></td>"
+                )
+        if cells:
+            rows += f"<tr><td>{html.escape(task)}</td>{cells}</tr>"
+    if not rows:
+        return ""
+    return f"<table>{rows}</table>"
+
+
 def _metrics_html(metrics: dict[str, dict]) -> str:
     if not metrics:
         return "<p>(no metrics reported)</p>"
@@ -191,6 +250,7 @@ def _job_html(detail: dict) -> str:
         f"<h2>tasks</h2><table><tr><th>task</th><th>state</th><th>exit</th>"
         f"<th>attempts</th></tr>{tasks}</table>"
         f"<h2>metrics</h2>{_metrics_html(_latest_metrics(detail['events']))}"
+        f"{_charts_html(_metric_series(detail['events']))}"
         f"<h2>logs</h2><ul>{logs}</ul>"
         f"<h2>events</h2><pre>{events}</pre>"
         f"<h2>config</h2><pre>{config}</pre>"
