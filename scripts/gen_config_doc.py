@@ -4,8 +4,11 @@ truth: tony_tpu/config/keys.py). Re-run after adding keys."""
 import inspect
 import os
 import re
+import sys
 
-from tony_tpu.config import keys as K
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tony_tpu.config import keys as K  # noqa: E402
 
 
 def main() -> None:

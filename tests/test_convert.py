@@ -1,0 +1,71 @@
+"""HF checkpoint conversion: weights from transformers' LlamaForCausalLM
+must produce matching logits through our forward (the migration lane for
+existing torch checkpoints), and the mapping must round-trip."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from tony_tpu.models.convert import from_hf_state_dict, to_hf_state_dict
+from tony_tpu.models.llama import LlamaConfig, forward
+
+
+def _tiny_pair():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = LlamaConfig.tiny()
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.dim,
+        intermediate_size=cfg.ffn_dim,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        max_position_embeddings=cfg.max_seq_len,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta,
+        attention_bias=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    return cfg, model
+
+
+def test_logits_match_transformers():
+    torch = pytest.importorskip("torch")
+    cfg, model = _tiny_pair()
+    params = from_hf_state_dict(model.state_dict(), cfg)
+    tokens = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16))
+    with torch.no_grad():
+        want = model(torch.tensor(tokens)).logits.float().numpy()
+    got = np.asarray(forward(params, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_state_dict_roundtrip():
+    torch = pytest.importorskip("torch")
+    cfg, model = _tiny_pair()
+    params = from_hf_state_dict(model.state_dict(), cfg)
+    back = to_hf_state_dict(params, cfg)
+    sd = {k: v.detach().float().numpy() for k, v in model.state_dict().items()}
+    assert set(back) == set(sd)
+    for k in sd:
+        np.testing.assert_allclose(back[k], sd[k], atol=1e-6, err_msg=k)
+
+
+def test_strict_shape_and_key_errors():
+    cfg, model = _tiny_pair()
+    sd = dict(model.state_dict())
+    bad = dict(sd)
+    del bad["model.norm.weight"]
+    with pytest.raises(KeyError, match="norm.weight"):
+        from_hf_state_dict(bad, cfg)
+    import torch
+
+    bad = dict(sd)
+    bad["model.embed_tokens.weight"] = torch.zeros(7, 7)
+    with pytest.raises(ValueError, match="embed_tokens"):
+        from_hf_state_dict(bad, cfg)

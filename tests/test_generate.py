@@ -73,3 +73,35 @@ def test_sampled_generation_respects_top_k(setup):
     # top_k=1 sampling degenerates to greedy
     greedy = generate(params, prompt, cfg, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(greedy))
+
+
+def test_top_p_restricts_to_nucleus():
+    """top_p sampling only ever emits tokens from the smallest prefix whose
+    cumulative probability reaches p."""
+    from tony_tpu.models.generate import _sample
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    seen = set()
+    for i in range(64):
+        tok = _sample(logits, temperature=1.0, top_k=0, top_p=0.6,
+                      rng=jax.random.key(i))
+        seen.add(int(tok[0]))
+    # 0.5 alone < 0.6, so token 1 joins the nucleus; 2 and 3 never can
+    assert seen <= {0, 1}
+    assert 0 in seen
+
+
+def test_eos_rows_stick():
+    """Rows that emit eos keep emitting it (static-shape early stop)."""
+    from tony_tpu.models.generate import generate
+    from tony_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    # greedy with eos_id equal to whatever the first generated token is:
+    # every subsequent token must then repeat it
+    first = generate(params, prompt, cfg, max_new_tokens=1)[0, -1]
+    out = generate(params, prompt, cfg, max_new_tokens=6, eos_id=int(first))
+    tail = np.asarray(out[0, 3:])
+    assert (tail == int(first)).all(), tail

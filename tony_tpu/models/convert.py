@@ -1,0 +1,136 @@
+"""Checkpoint conversion: HuggingFace/torch Llama weights -> tony-tpu pytree.
+
+The reference orchestrates user scripts and never touches weights; a
+migration story needs one. This maps a HuggingFace `LlamaForCausalLM`
+state_dict (torch tensors or numpy arrays, e.g. `torch.load`-ed from local
+disk — this environment has no network) onto the stacked-per-layer pytree
+`tony_tpu.models.llama.init_params` produces, transposing torch's
+[out, in] Linear layout to our [in, out] matmul layout. Rotary needs no
+re-permutation: our apply_rope uses the same half-split (rotate_half)
+convention HF checkpoints are stored in — logits match transformers'
+LlamaForCausalLM to float tolerance (tests/test_convert.py).
+
+    state = transformers.LlamaForCausalLM.from_pretrained(path).state_dict()
+    params = from_hf_state_dict(state, cfg)   # (or safetensors tensors)
+    logits = forward(params, tokens, cfg)
+
+Meta's original `consolidated.*.pth` shards use different key names AND the
+interleaved rotary layout — convert those to HF format first (the
+`transformers` conversion script); only the HF layout is handled here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from tony_tpu.models.llama import LlamaConfig, Params
+
+
+def _to_np(x: Any) -> np.ndarray:
+    if hasattr(x, "detach"):  # torch tensor without importing torch
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x)
+
+
+def from_hf_state_dict(
+    state: Mapping[str, Any], cfg: LlamaConfig, *, strict: bool = True
+) -> Params:
+    """Build the model pytree from a HF `LlamaForCausalLM` state_dict.
+
+    ``strict`` verifies every expected key exists and shapes agree (clear
+    errors beat silent garbage weights).
+    """
+    if cfg.is_moe:
+        raise NotImplementedError(
+            "HF conversion covers dense Llama configs; MoE trees (router/"
+            "per-expert ffn) have no HF Llama layout to map from"
+        )
+    sd = {k.removeprefix("model."): v for k, v in state.items()}
+    L, d = cfg.n_layers, cfg.dim
+    dtype = cfg.dtype
+
+    def get(key: str, shape: tuple[int, ...]) -> np.ndarray:
+        if key not in sd:
+            raise KeyError(f"missing weight {key!r} (have {len(sd)} keys)")
+        w = _to_np(sd[key])
+        if strict and tuple(w.shape) != shape:
+            raise ValueError(f"{key}: expected shape {shape}, got {tuple(w.shape)}")
+        return w
+
+    # NOTE on rotary: HF stores q/k projections in its half-split
+    # (rotate_half) convention — which is exactly what our apply_rope
+    # implements, so q/k need no permutation (only the original Meta
+    # release's interleaved-pair layout would).
+    def stack(fmt: str, shape: tuple[int, ...], *, transpose: bool = True) -> jnp.ndarray:
+        per = []
+        for i in range(L):
+            w = get(fmt.format(i=i), shape)
+            per.append(w.T if transpose else w)  # torch Linear is [out, in]
+        return jnp.asarray(np.stack(per), dtype)
+
+    nq = cfg.n_heads * cfg.head_dim
+    nkv = cfg.n_kv_heads * cfg.head_dim
+    F = cfg.ffn_dim
+    params: Params = {
+        "tok_emb": jnp.asarray(
+            get("embed_tokens.weight", (cfg.vocab_size, d)), dtype
+        ),
+        "layers": {
+            "attn_norm": stack(
+                "layers.{i}.input_layernorm.weight", (d,), transpose=False
+            ),
+            "wq": stack("layers.{i}.self_attn.q_proj.weight", (nq, d)),
+            "wk": stack("layers.{i}.self_attn.k_proj.weight", (nkv, d)),
+            "wv": stack("layers.{i}.self_attn.v_proj.weight", (nkv, d)),
+            "wo": stack("layers.{i}.self_attn.o_proj.weight", (d, nq)),
+            "ffn_norm": stack(
+                "layers.{i}.post_attention_layernorm.weight", (d,),
+                transpose=False,
+            ),
+            "w1": stack("layers.{i}.mlp.gate_proj.weight", (F, d)),
+            "w3": stack("layers.{i}.mlp.up_proj.weight", (F, d)),
+            "w2": stack("layers.{i}.mlp.down_proj.weight", (d, F)),
+        },
+        "final_norm": jnp.asarray(get("norm.weight", (d,)), dtype),
+        "lm_head": jnp.asarray(
+            get("lm_head.weight", (cfg.vocab_size, d)).T, dtype
+        ),
+    }
+    return params
+
+
+def to_hf_state_dict(params: Params, cfg: LlamaConfig) -> dict[str, np.ndarray]:
+    """Inverse mapping (numpy arrays, HF key layout) — lets weights trained
+    here be loaded back into `transformers` for eval/serving parity checks."""
+    if cfg.is_moe:
+        raise NotImplementedError(
+            "HF conversion covers dense Llama configs; MoE expert stacks "
+            "would silently axis-scramble under this dense mapping"
+        )
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(
+        params["tok_emb"], dtype=np.float32
+    )
+    out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T
+    out["model.norm.weight"] = np.asarray(params["final_norm"], np.float32)
+    lp = params["layers"]
+
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}"
+        get = lambda name: np.asarray(lp[name][i], np.float32)  # noqa: E731
+        out[f"{pre}.input_layernorm.weight"] = get("attn_norm")
+        out[f"{pre}.post_attention_layernorm.weight"] = get("ffn_norm")
+        out[f"{pre}.self_attn.q_proj.weight"] = get("wq").T
+        out[f"{pre}.self_attn.k_proj.weight"] = get("wk").T
+        out[f"{pre}.self_attn.v_proj.weight"] = get("wv").T
+        out[f"{pre}.self_attn.o_proj.weight"] = get("wo").T
+        out[f"{pre}.mlp.gate_proj.weight"] = get("w1").T
+        out[f"{pre}.mlp.up_proj.weight"] = get("w3").T
+        out[f"{pre}.mlp.down_proj.weight"] = get("w2").T
+    return out
+
+
+__all__ = ["from_hf_state_dict", "to_hf_state_dict"]

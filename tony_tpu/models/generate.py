@@ -108,13 +108,17 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
+    eos_id: int | None = None,
     rng: jax.Array | None = None,
     max_len: int = 0,
 ) -> jax.Array:
     """Autoregressive generation. prompt [B,P] -> [B, P+max_new_tokens].
 
     temperature 0 = greedy; otherwise softmax sampling, optionally top-k
-    truncated. The decode loop is one jitted lax.scan over steps.
+    and/or nucleus (top-p) truncated. ``eos_id`` makes finished rows stick
+    at EOS (static shapes: the scan always runs max_new_tokens steps; rows
+    that hit EOS keep emitting it). The decode loop is one jitted lax.scan.
     """
     B, P = prompt.shape
     total = P + max_new_tokens
@@ -125,30 +129,58 @@ def generate(
     prefill = jax.jit(partial(forward_with_cache, cfg=cfg))
     logits, cache = prefill(params, prompt, cache, jnp.int32(0))
     next_rng, rng = jax.random.split(rng)
-    last = _sample(logits[:, -1], temperature, top_k, next_rng)
+    last = _sample(logits[:, -1], temperature, top_k, top_p, next_rng)
+    done0 = (
+        last == eos_id if eos_id is not None else jnp.zeros((B,), bool)
+    )
 
     def step(carry, rng_step):
-        cache, tok, pos = carry
+        cache, tok, pos, done = carry
         logits, cache = forward_with_cache(params, tok[:, None], cache, pos, cfg)
-        nxt = _sample(logits[:, -1], temperature, top_k, rng_step)
-        return (cache, nxt, pos + 1), tok
+        nxt = _sample(logits[:, -1], temperature, top_k, top_p, rng_step)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, pos + 1, done), tok
 
     # scan emits each step's *input* token, so ys = [last, nxt_1, ...,
     # nxt_{T-1}] — exactly the max_new_tokens generated tokens in order.
     steps_rng = jax.random.split(rng, max_new_tokens)
-    _, toks = jax.jit(partial(lax.scan, step))((cache, last, jnp.int32(P)), steps_rng)
+    _, toks = jax.jit(partial(lax.scan, step))(
+        (cache, last, jnp.int32(P), done0), steps_rng
+    )
     generated = jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
     return jnp.concatenate([prompt, generated], axis=1)
 
 
-def _sample(logits: jax.Array, temperature: float, top_k: int, rng: jax.Array) -> jax.Array:
+def _sample(logits: jax.Array, temperature: float, top_k: int, top_p: float,
+            rng: jax.Array) -> jax.Array:
     """logits [B,V] -> token ids [B]."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
-    if top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_k > 0 or top_p > 0.0:
+        # one descending sort serves both truncations (V log V per decode
+        # step is the dominant cost of sampling at real vocab sizes)
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        if top_k > 0:
+            kth = sorted_logits[:, top_k - 1][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            sorted_logits = jnp.where(
+                sorted_logits < kth, -jnp.inf, sorted_logits
+            )
+        if top_p > 0.0:
+            # nucleus: keep the smallest prefix of the sorted distribution
+            # whose cumulative probability reaches top_p (the top token
+            # always stays)
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = (cum - probs) < top_p
+            # the smallest kept logit per row is the admission threshold
+            cutoff = jnp.min(
+                jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1
+            )[:, None]
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
