@@ -51,7 +51,8 @@ class LlamaConfig:
     remat_policy: str = "nothing"
     # 'dot' = fused plain attention; 'flash' = pallas kernel (tony_tpu.ops);
     # 'ring' = sequence-parallel ring attention (tony_tpu.parallel);
-    # 'ulysses' = all-to-all head-sharded sequence parallelism.
+    # 'ring_flash' = ring over sp with the pallas kernel per chunk (the
+    # long-context production path); 'ulysses' = all-to-all head sharding.
     attention_impl: str = "dot"
     # pallas flash kernel tile sizes (attention_impl='flash'); clipped to S.
     # 1024/1024 measured fastest on v5e at S=2048 (43.7 -> 53.2 TF/s fwd vs
@@ -296,6 +297,10 @@ def _get_attention(cfg: LlamaConfig) -> AttnFn:
             from tony_tpu.parallel.ring_attention import ring_attention
 
             return ring_attention
+        if cfg.attention_impl == "ring_flash":
+            from tony_tpu.parallel.ring_attention import ring_flash_attention
+
+            return ring_flash_attention
         if cfg.attention_impl == "ulysses":
             from tony_tpu.parallel.ulysses import ulysses_attention
 
@@ -319,10 +324,12 @@ def attention_block(x: jax.Array, lp: Params, cfg: LlamaConfig,
     q = checkpoint_name(apply_rope(q, cos, sin), "attn_qkv")
     k = checkpoint_name(apply_rope(k, cos, sin), "attn_qkv")
     v = checkpoint_name(v, "attn_qkv")
-    # GQA: the flash kernel reads each kv head n_heads/n_kv_heads times via
-    # its BlockSpec index map — no HBM-materialised repeat. Other impls get
-    # the expanded kv tensors.
-    if cfg.n_kv_heads != cfg.n_heads and cfg.attention_impl != "flash":
+    # GQA: the flash kernels read each kv head n_heads/n_kv_heads times via
+    # their BlockSpec index maps — no HBM-materialised repeat (and for
+    # ring_flash, no repeat riding every ppermute hop). Other impls get the
+    # expanded kv tensors.
+    if (cfg.n_kv_heads != cfg.n_heads
+            and cfg.attention_impl not in ("flash", "ring_flash")):
         rep = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)

@@ -222,45 +222,51 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal, heads, kv_heads):
-    q, k, v, out, lse = res
+def flash_dq_pass(q, k, v, do, lse, delta, *, scale, blk_q, blk_k, causal,
+                  heads, kv_heads):
+    """dq from explicit (lse, delta) — usable with a GLOBAL lse/delta, which
+    is what blockwise/ring backward passes need. Shapes: q/do [B*heads,S,D],
+    k/v [B*kv_heads,S,D], lse/delta [B*heads,1,S] fp32."""
     BH, S, D = q.shape
-    BKV = k.shape[0]
-    rep = heads // kv_heads
     nq, nk = pl.cdiv(S, blk_q), pl.cdiv(S, blk_k)
-    delta = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )[:, None, :]  # [BH, 1, S]
-
     qspec = pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec(
         (1, blk_k, D), lambda b, i, j: (_kv_index(b, heads, kv_heads), j, 0)
     )
     rowspec = pl.BlockSpec((1, 1, blk_q), lambda b, i, j: (b, 0, i))
-
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal),
         grid=(BH, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=[qspec],
-        out_shape=[_out_struct((BH, S, D), q.dtype, q, k, v, g)],
+        out_shape=[_out_struct((BH, S, D), q.dtype, q, k, v, do)],
         scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_use_interpret(),
-    )(q, k, v, g, lse, delta)[0]
+    )(q, k, v, do, lse, delta)[0]
 
-    # dk/dv pass: grid over the [B*kv_heads] K/V array; k-block outer, then
-    # the inner dim walks rep*nq q-blocks (all query heads of the GQA group
-    # back-to-back) so dk/dv accumulate in VMEM scratch across the group.
+
+def flash_dkv_pass(q, k, v, do, lse, delta, *, scale, blk_q, blk_k, causal,
+                   heads, kv_heads):
+    """dk/dv from explicit (lse, delta); see flash_dq_pass.
+
+    Grid over the [B*kv_heads] K/V array; k-block outer, then the inner dim
+    walks rep*nq q-blocks (all query heads of the GQA group back-to-back) so
+    dk/dv accumulate in VMEM scratch across the group."""
+    BH, S, D = q.shape
+    BKV = k.shape[0]
+    rep = heads // kv_heads
+    nq, nk = pl.cdiv(S, blk_q), pl.cdiv(S, blk_k)
+
     def _q_index(b: int, i: int) -> int:
         return (b // kv_heads) * heads + (b % kv_heads) * rep + i // nq
 
     qspec_t = pl.BlockSpec((1, blk_q, D), lambda b, j, i: (_q_index(b, i), i % nq, 0))
     kspec_t = pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0))
     rowspec_t = pl.BlockSpec((1, 1, blk_q), lambda b, j, i: (_q_index(b, i), 0, i % nq))
-    dk, dv = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, blk_q=blk_q, blk_k=blk_k,
             causal=causal, nq=nq,
@@ -269,8 +275,8 @@ def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal, heads, kv_heads):
         in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t],
         out_specs=[kspec_t, kspec_t],
         out_shape=[
-            _out_struct((BKV, S, D), k.dtype, q, k, v, g),
-            _out_struct((BKV, S, D), v.dtype, q, k, v, g),
+            _out_struct((BKV, S, D), k.dtype, q, k, v, do),
+            _out_struct((BKV, S, D), v.dtype, q, k, v, do),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_k, D), jnp.float32),
@@ -280,7 +286,18 @@ def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal, heads, kv_heads):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_use_interpret(),
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, do, lse, delta)
+
+
+def _flash_bwd(res, g, *, scale, blk_q, blk_k, causal, heads, kv_heads):
+    q, k, v, out, lse = res
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )[:, None, :]  # [BH, 1, S]
+    kw = dict(scale=scale, blk_q=blk_q, blk_k=blk_k, causal=causal,
+              heads=heads, kv_heads=kv_heads)
+    dq = flash_dq_pass(q, k, v, g, lse, delta, **kw)
+    dk, dv = flash_dkv_pass(q, k, v, g, lse, delta, **kw)
     return dq, dk, dv
 
 
@@ -399,4 +416,11 @@ def sharded_flash_attention(q, k, v, cfg=None, **kwargs) -> jax.Array:
     )(q, k, v)
 
 
-__all__ = ["flash_attention", "sharded_flash_attention"]
+# explicit-residual entry for blockwise/ring composition:
+# (q, k, v) -> (out, lse) with lse in [B*heads, 1, S] fp32 kernel layout
+flash_fwd_pass = _flash_fwd
+
+__all__ = [
+    "flash_attention", "flash_dq_pass", "flash_dkv_pass", "flash_fwd_pass",
+    "sharded_flash_attention",
+]

@@ -25,6 +25,7 @@ from tony_tpu.parallel.pipeline import (
 )
 from tony_tpu.parallel.ring_attention import (
     make_ring_attention,
+    make_ring_flash_attention,
     ring_attention,
     ring_attention_local,
 )
@@ -43,6 +44,7 @@ __all__ = [
     "get_default_mesh",
     "init_moe_params",
     "make_ring_attention",
+    "make_ring_flash_attention",
     "make_ulysses_attention",
     "microbatch",
     "moe_block",
