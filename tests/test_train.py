@@ -220,3 +220,22 @@ def test_fit_moe_expert_parallel_tiny_model():
     final = fit(cfg)
     assert np.isfinite(final["final_loss"])
     assert final["final_loss"] < 5.2
+
+
+def test_fit_ring_flash_context_parallel():
+    """fit() trains with attention_impl='ring_flash' on an sp mesh: the
+    sequence axis is sharded, K/V chunks ride the ppermute ring, and the
+    pallas kernel (interpreter mode on CPU) runs per chunk."""
+    import dataclasses
+
+    cfg = FitConfig(
+        model=dataclasses.replace(LlamaConfig.tiny(), attention_impl="ring_flash"),
+        data=DataConfig(global_batch=4, seq_len=64, vocab_size=256),
+        mesh_shape=MeshShape(sp=2, fsdp=2),
+        steps=6,
+        log_every=3,
+        lr=5e-3,
+        warmup_steps=2,
+    )
+    final = fit(cfg)
+    assert np.isfinite(final["final_loss"])
