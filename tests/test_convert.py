@@ -69,3 +69,15 @@ def test_strict_shape_and_key_errors():
     bad["model.embed_tokens.weight"] = torch.zeros(7, 7)
     with pytest.raises(ValueError, match="embed_tokens"):
         from_hf_state_dict(bad, cfg)
+
+
+def test_tied_embeddings_fallback():
+    """tie_word_embeddings checkpoints (Llama 3.2 1B/3B, TinyLlama) omit
+    lm_head.weight; conversion must use embed_tokens as the head."""
+    torch = pytest.importorskip("torch")
+    cfg, model = _tiny_pair()
+    sd = dict(model.state_dict())
+    del sd["lm_head.weight"]
+    params = from_hf_state_dict(sd, cfg)
+    want = sd["model.embed_tokens.weight"].detach().float().numpy().T
+    np.testing.assert_allclose(np.asarray(params["lm_head"]), want, atol=1e-6)

@@ -372,3 +372,121 @@ def test_e2e_remote_localized_elastic_resume(tmp_path):
     assert hosts
     app = os.listdir(root / hosts[0])[0]
     assert os.path.isfile(root / hosts[0] / app / "src" / "train.py")
+
+
+def test_concurrent_allocate_waits_for_localization(tmp_path):
+    """Two allocations racing the same (host, app): the second must block
+    until the first's copy COMPLETES, never launch against a half-copied
+    app dir (remote.py _localize_app in-flight event)."""
+    import threading
+
+    app = tmp_path / "app"
+    app.mkdir()
+    (app / "config.json").write_text("{}")
+    (app / "payload.bin").write_bytes(b"x" * 64)
+    root = tmp_path / "localized"
+
+    started = threading.Event()
+    release = threading.Event()
+
+    class SlowTransport(LocalTransport):
+        def localize(self, host, src_dir, dst_dir):
+            started.set()
+            assert release.wait(10), "test deadlock"
+            super().localize(host, src_dir, dst_dir)
+
+    b = RemoteBackend(
+        ["127.0.0.1"],
+        transport=SlowTransport(),
+        host_capacity=Resource(memory_mb=256, cpus=4, tpu_chips=0),
+        localize=True,
+        localize_root=str(root),
+    )
+    b.start()
+    env = {"TONY_APP_DIR": str(app), "TONY_APP_ID": "app-1"}
+    seen = []
+
+    def alloc(i):
+        r = req(idx=i, log_path=str(tmp_path / f"c{i}.log"))
+        r.env.update(env)
+        c = b.allocate(r)
+        # at launch time the localized copy must be complete
+        dst = root / "127.0.0.1" / "app-1"
+        seen.append((dst / "payload.bin").exists())
+        return c
+
+    try:
+        t1 = threading.Thread(target=alloc, args=(0,))
+        t1.start()
+        assert started.wait(10)
+        t2 = threading.Thread(target=alloc, args=(1,))
+        t2.start()
+        time.sleep(0.3)  # give t2 the chance to (wrongly) skip the wait
+        assert not seen, "an allocation launched before the copy finished"
+        release.set()
+        t1.join(10)
+        t2.join(10)
+        assert seen == [True, True]
+    finally:
+        release.set()
+        b.stop()
+
+
+def test_failed_localization_retried_by_waiter(tmp_path):
+    """A failing first copy must not let a waiting allocation fall through:
+    the waiter joins/starts a retry and launches only after a COMPLETED
+    copy (the copier's own allocate raises)."""
+    import threading
+
+    app = tmp_path / "app"
+    app.mkdir()
+    (app / "config.json").write_text("{}")
+    (app / "payload.bin").write_bytes(b"x" * 64)
+    root = tmp_path / "localized"
+
+    calls = []
+    gate = threading.Event()
+
+    class FlakyTransport(LocalTransport):
+        def localize(self, host, src, dst):
+            calls.append(1)
+            if len(calls) == 1:
+                gate.set()
+                time.sleep(0.2)
+                raise OSError("simulated copy failure")
+            super().localize(host, src, dst)
+
+    b = RemoteBackend(
+        ["127.0.0.1"],
+        transport=FlakyTransport(),
+        host_capacity=Resource(memory_mb=256, cpus=4, tpu_chips=0),
+        localize=True,
+        localize_root=str(root),
+    )
+    b.start()
+    env = {"TONY_APP_DIR": str(app), "TONY_APP_ID": "app-1"}
+    results = {}
+
+    def alloc(i):
+        r = req(idx=i, log_path=str(tmp_path / f"c{i}.log"))
+        r.env.update(env)
+        try:
+            b.allocate(r)
+            dst = root / "127.0.0.1" / "app-1" / "payload.bin"
+            results[i] = ("ok", dst.exists())
+        except OSError as e:
+            results[i] = ("fail", str(e))
+
+    try:
+        t1 = threading.Thread(target=alloc, args=(0,))
+        t1.start()
+        assert gate.wait(5)
+        t2 = threading.Thread(target=alloc, args=(1,))
+        t2.start()
+        t1.join(15)
+        t2.join(15)
+        assert results[0][0] == "fail", results
+        assert results[1] == ("ok", True), results
+        assert len(calls) == 2
+    finally:
+        b.stop()

@@ -178,9 +178,11 @@ def test_fit_pipeline_with_flash_attention():
     assert np.isfinite(final["final_loss"])
 
 
-def test_pipeline_rejects_sequence_parallel_attention():
-    """pp x ring/ulysses composes two manual shard_map regions, which the
-    partitioner cannot express — must fail loudly at build time."""
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
+def test_pipeline_rejects_sequence_parallel_attention(impl):
+    """pp x ring/ring_flash/ulysses composes two manual shard_map regions,
+    which the partitioner cannot express — must fail loudly at build time
+    with the ONE consistent _pp_guard message."""
     import dataclasses
 
     import jax
@@ -192,7 +194,7 @@ def test_pipeline_rejects_sequence_parallel_attention():
     )
 
     cfg = dataclasses.replace(
-        LlamaConfig.tiny(), n_layers=4, attention_impl="ring"
+        LlamaConfig.tiny(), n_layers=4, attention_impl=impl
     )
     mesh = build_mesh(MeshShape(pp=2, sp=2, fsdp=2))
     set_default_mesh(mesh)
@@ -201,7 +203,7 @@ def test_pipeline_rejects_sequence_parallel_attention():
     state = make_train_state(jax.random.key(0), cfg, mesh, opt, rules)
     step = make_train_step(cfg, mesh, opt, rules, n_microbatches=4)
     tokens = np.random.default_rng(0).integers(0, 256, (8, 33))
-    with pytest.raises(NotImplementedError, match="ring"):
+    with pytest.raises(NotImplementedError, match="cannot nest inside pipeline"):
         step(state, jnp.asarray(tokens[:, :-1]), jnp.asarray(tokens[:, 1:]))
 
 

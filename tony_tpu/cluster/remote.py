@@ -343,7 +343,10 @@ class RemoteBackend:
         self._localize_root = localize_root or os.path.expanduser(
             os.path.join("~", ".tony-tpu", "localized")
         )
-        self._localized: set[tuple[str, str]] = set()
+        # (host, app) -> Event set once the copy COMPLETES; concurrent
+        # allocations for the same key wait on it instead of racing a
+        # half-copied app dir (allocate() is not contractually serial)
+        self._localized: dict[tuple[str, str], threading.Event] = {}
         self._containers: dict[str, Container] = {}
         self._procs: dict[str, RemoteProcess] = {}
         self._logs: dict[str, IO[bytes]] = {}
@@ -481,18 +484,35 @@ class RemoteBackend:
             return
         dst = os.path.join(self._localize_root, host, app_id)
         key = (host, app_id)
-        with self._lock:
-            needed = key not in self._localized
+        while True:
+            with self._lock:
+                done = self._localized.get(key)
+                needed = done is None
+                if needed:
+                    done = self._localized[key] = threading.Event()
             if needed:
-                self._localized.add(key)
-        if needed:
-            try:
-                self.transport.localize(host, app_dir, dst)
-                log.info("localized %s to %s:%s", app_id, host, dst)
-            except Exception:
-                with self._lock:
-                    self._localized.discard(key)
-                raise
+                try:
+                    self.transport.localize(host, app_dir, dst)
+                    log.info("localized %s to %s:%s", app_id, host, dst)
+                except Exception:
+                    with self._lock:
+                        self._localized.pop(key, None)
+                    done.set()  # wake waiters; they see the key changed
+                    raise
+                done.set()
+                break
+            if not done.wait(timeout=600):
+                raise TimeoutError(
+                    f"localization of {app_id} to {host} stalled"
+                )
+            with self._lock:
+                current = self._localized.get(key)
+            if current is done:
+                break  # the copy we waited on completed successfully
+            # failed-and-cleared (None) or another waiter already retrying
+            # (a NEW event): loop to join/start the retry — never fall
+            # through on bare key presence, a fresh in-flight event is not
+            # a finished copy
         env["TONY_APP_DIR"] = dst
         env["TONY_CONF_PATH"] = os.path.join(dst, "config.json")
 

@@ -74,10 +74,9 @@ def from_hf_state_dict(
     nq = cfg.n_heads * cfg.head_dim
     nkv = cfg.n_kv_heads * cfg.head_dim
     F = cfg.ffn_dim
+    emb = get("embed_tokens.weight", (cfg.vocab_size, d))
     params: Params = {
-        "tok_emb": jnp.asarray(
-            get("embed_tokens.weight", (cfg.vocab_size, d)), dtype
-        ),
+        "tok_emb": jnp.asarray(emb, dtype),
         "layers": {
             "attn_norm": stack(
                 "layers.{i}.input_layernorm.weight", (d,), transpose=False
@@ -95,8 +94,13 @@ def from_hf_state_dict(
             "w2": stack("layers.{i}.mlp.down_proj.weight", (d, F)),
         },
         "final_norm": jnp.asarray(get("norm.weight", (d,)), dtype),
+        # tie_word_embeddings checkpoints (Llama 3.2 1B/3B, TinyLlama) omit
+        # lm_head.weight from the state_dict; the tied head IS the embedding
         "lm_head": jnp.asarray(
-            get("lm_head.weight", (cfg.vocab_size, d)).T, dtype
+            get("lm_head.weight", (cfg.vocab_size, d)).T
+            if "lm_head.weight" in sd
+            else emb.T,
+            dtype,
         ),
     }
     return params

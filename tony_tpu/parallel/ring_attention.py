@@ -331,10 +331,12 @@ def make_ring_flash_attention(mesh: Mesh, *, axis_name: str = "sp"):
             rep = q.shape[2] // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        # check_vma=False: interpreter-mode pallas (CPU tests) trips jax's
-        # varying-axes checker on the kernel's internal dynamic_slice with
-        # unvarying grid indices; semantics are unchanged (the dense ring
-        # passes the same specs WITH the checker on)
+        # Interpreter-mode pallas (CPU tests) trips jax's varying-axes
+        # checker on the kernel's internal dynamic_slice with unvarying
+        # grid indices, so the checker is off ONLY there; on real TPU it
+        # stays on — same vma discipline as the dense ring path.
+        from tony_tpu.ops.attention import _use_interpret
+
         return jax.shard_map(
             lambda a, b, c: ring_flash_attention_local(
                 a, b, c, axis_name, blk_q, blk_k
@@ -342,7 +344,7 @@ def make_ring_flash_attention(mesh: Mesh, *, axis_name: str = "sp"):
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
-            check_vma=False,
+            check_vma=not _use_interpret(),
         )(q, k, v)
 
     return attn

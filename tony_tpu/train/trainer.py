@@ -208,7 +208,7 @@ def pp_1f1b_loss_from_pairs(
 
 
 def _pp_guard(cfg: llama.LlamaConfig, mesh: Mesh) -> None:
-    if cfg.attention_impl in ("ring", "ulysses"):
+    if cfg.attention_impl in ("ring", "ring_flash", "ulysses"):
         # shardy cannot re-bind collective axes inside the pp-manual stage
         # region (verifier rejects nested manual computations over sp)
         raise NotImplementedError(
