@@ -554,3 +554,95 @@ def test_horovod_job_rendezvous_roundtrip(tmp_path):
         for n in sorted(os.listdir(logs_dir)):
             print(f"===== {n}", open(os.path.join(logs_dir, n), errors="replace").read()[-1500:])
     assert code == 0
+
+
+def test_concurrent_jobs_share_rm_store_queue_then_run(tmp_path):
+    """The YARN-RM parity E2E (SURVEY.md section 1 L0): two jobs submitted
+    concurrently against ONE chip inventory via cluster.rm_root. The second
+    job's gang queues in the shared store while the first holds every chip,
+    then runs to success after the first finishes — instead of both
+    double-booking the chips (which is what two per-process inventories
+    would silently do)."""
+    import threading
+    import time as _time
+
+    rm_root = str(tmp_path / "rm")
+    results = {}
+    t0 = _time.monotonic()
+
+    def run_job(name, sleep_s):
+        code, app_dir = submit(
+            tmp_path,
+            {
+                "application.name": name,
+                "application.framework": "generic",
+                "cluster.rm_root": rm_root,
+                "am.allocation_timeout_s": 60,
+                "job.worker.instances": 1,
+                # the FULL default local inventory (64 chips): jobs serialize
+                "job.worker.tpu_chips": 64,
+                "job.worker.command": (
+                    f'python -c "import time; time.sleep({sleep_s})"'
+                ),
+            },
+        )
+        results[name] = (code, app_dir, _time.monotonic() - t0)
+
+    ta = threading.Thread(target=run_job, args=("rm-first", 3))
+    ta.start()
+    _time.sleep(1.0)  # let job A take the chips first
+    tb = threading.Thread(target=run_job, args=("rm-second", 0))
+    tb.start()
+    ta.join(90)
+    tb.join(90)
+    code_a, dir_a, _ = results["rm-first"]
+    code_b, dir_b, dur_b = results["rm-second"]
+    assert code_a == 0 and read_status(dir_a)["state"] == "SUCCEEDED"
+    assert code_b == 0 and read_status(dir_b)["state"] == "SUCCEEDED"
+    # job B could not have run concurrently: it waited out A's ~3s sleep
+    assert dur_b > 3.0
+    # all leases returned at job end
+    from tony_tpu.cluster.lease import LeaseStore
+
+    summary = LeaseStore(rm_root).summary()
+    assert not summary["apps"] and not summary["queue"]
+
+
+def test_concurrent_job_clean_rejection_names_holder(tmp_path):
+    """With a short allocation timeout the queued job is REJECTED with a
+    message naming the holder, and the client exits nonzero."""
+    import threading
+    import time as _time
+
+    rm_root = str(tmp_path / "rm")
+    results = {}
+
+    def run_job(name, sleep_s, timeout_s):
+        code, app_dir = submit(
+            tmp_path,
+            {
+                "application.name": name,
+                "application.framework": "generic",
+                "cluster.rm_root": rm_root,
+                "am.allocation_timeout_s": timeout_s,
+                "job.worker.instances": 1,
+                "job.worker.tpu_chips": 64,
+                "job.worker.command": (
+                    f'python -c "import time; time.sleep({sleep_s})"'
+                ),
+            },
+        )
+        results[name] = (code, app_dir)
+
+    ta = threading.Thread(target=run_job, args=("rm-holder", 8, 60))
+    ta.start()
+    _time.sleep(1.0)
+    tb = threading.Thread(target=run_job, args=("rm-rejected", 0, 2))
+    tb.start()
+    tb.join(60)
+    code_b, dir_b = results["rm-rejected"]
+    assert code_b != 0
+    status = read_status(dir_b)
+    assert status["state"] == "FAILED"
+    ta.join(90)
+    assert results["rm-holder"][0] == 0

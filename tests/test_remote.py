@@ -490,3 +490,50 @@ def test_failed_localization_retried_by_waiter(tmp_path):
         assert len(calls) == 2
     finally:
         b.stop()
+
+
+def test_e2e_remote_concurrent_jobs_share_rm_store(tmp_path):
+    """Cross-job arbitration on the REMOTE backend: two jobs submitted
+    concurrently against one 4-chip host through cluster.rm_root — the
+    second queues in the shared store and succeeds after the first, chips
+    never double-booked (the YARN-RM parity path, SURVEY.md section 1 L0)."""
+    import threading
+    import time as _time
+
+    rm_root = str(tmp_path / "rm")
+    results = {}
+    t0 = _time.monotonic()
+
+    def run_job(name, sleep_s):
+        code, app_dir = submit_remote(
+            tmp_path,
+            {
+                "application.name": name,
+                "application.framework": "generic",
+                "cluster.hosts": "127.0.0.1",
+                "cluster.rm_root": rm_root,
+                "am.allocation_timeout_s": 60,
+                "job.worker.instances": 1,
+                "job.worker.tpu_chips": 4,  # the whole host
+                "job.worker.command": (
+                    f'python -c "import time; time.sleep({sleep_s})"'
+                ),
+            },
+        )
+        results[name] = (code, app_dir, _time.monotonic() - t0)
+
+    ta = threading.Thread(target=run_job, args=("rmr-first", 3))
+    ta.start()
+    _time.sleep(1.0)
+    tb = threading.Thread(target=run_job, args=("rmr-second", 0))
+    tb.start()
+    ta.join(90)
+    tb.join(90)
+    code_a, _, _ = results["rmr-first"]
+    code_b, _, dur_b = results["rmr-second"]
+    assert code_a == 0 and code_b == 0
+    assert dur_b > 3.0  # B waited out A's sleep; never ran beside it
+    from tony_tpu.cluster.lease import LeaseStore
+
+    summary = LeaseStore(rm_root).summary()
+    assert not summary["apps"] and not summary["queue"]

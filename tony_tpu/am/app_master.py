@@ -61,7 +61,9 @@ class ApplicationMaster(ApplicationRpcServicer):
 
         make_runtime(config.get_str(Keys.APPLICATION_FRAMEWORK, "jax")).validate(config)
         self.session = Session(self.specs, chief_type=chief)
-        self.backend = make_backend(config.get_str(Keys.CLUSTER_BACKEND, "local"), config)
+        self.backend = make_backend(
+            config.get_str(Keys.CLUSTER_BACKEND, "local"), config, app_id=app_id
+        )
         self.events = EventWriter(
             app_id,
             config.get_str(Keys.HISTORY_INTERMEDIATE_DIR)

@@ -117,6 +117,20 @@ class TaskScheduler:
                 raise InsufficientResources(
                     f"no single host can fit a {spec.name!r} container ({one})"
                 )
+        # Cross-job arbitration: gang-reserve the WHOLE job through the
+        # shared RM store (no-op without one) before any container launch —
+        # FIFO-queued behind earlier jobs, so two jobs can never interleave
+        # partial allocations into deadlock or double-book chips.
+        self.backend.reserve_job(
+            [
+                (Resource(spec.memory_mb, spec.cpus, spec.tpu_chips), spec.node_label)
+                for name in sorted(specs)
+                for spec in (specs[name],)
+                for _ in range(spec.instances)
+            ],
+            timeout_s=max(deadline - time.monotonic(), 0.0),
+            cancel=lambda: self._stop,
+        )
         while not self._stop:
             progress = False
             pending_left = False

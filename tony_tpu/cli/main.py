@@ -167,6 +167,36 @@ def cmd_history(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_rm_status(args: argparse.Namespace) -> int:
+    """Inspect (or clean) the shared ResourceManager lease store — the
+    `yarn top` analogue for the cross-job arbitration substrate."""
+    from tony_tpu.cluster.lease import LeaseStore
+
+    root = args.rm_root
+    if not root and args.conf:
+        from tony_tpu.config.config import TonyConfig
+        from tony_tpu.config.keys import Keys
+
+        root = TonyConfig.load(args.conf).get_str(Keys.CLUSTER_RM_ROOT, "")
+    if not root:
+        print(
+            "no RM store: pass --rm-root or a --conf with cluster.rm_root set",
+            file=sys.stderr,
+        )
+        return 2
+    if not os.path.isdir(os.path.expanduser(root)):
+        # inspection must not conjure an empty store out of a typo'd path
+        # and report a healthy idle cluster
+        print(f"no RM store at {root!r} (directory does not exist)", file=sys.stderr)
+        return 2
+    store = LeaseStore(root)
+    if args.release:
+        store.force_release_app(args.release)
+        print(f"released all leases of {args.release}")
+    print(json.dumps(store.summary(), indent=2))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tony", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -216,6 +246,18 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("history", help="list applications")
     s.add_argument("--dir", help="apps root (default ~/.tony-tpu/apps)")
     s.set_defaults(fn=cmd_history)
+
+    s = sub.add_parser(
+        "rm-status",
+        help="show the shared ResourceManager store (hosts, leases, queue)",
+    )
+    s.add_argument("--rm-root", default="", help="lease store directory")
+    s.add_argument("--conf", help="TOML config carrying cluster.rm_root")
+    s.add_argument(
+        "--release", default="", metavar="APP_ID",
+        help="force-release a (stale cross-host) app's leases first",
+    )
+    s.set_defaults(fn=cmd_rm_status)
     return p
 
 

@@ -8,6 +8,7 @@ from tony_tpu.cluster.backend import (
     InsufficientResources,
     Resource,
 )
+from tony_tpu.cluster.lease import GangAsk, LeaseStore
 from tony_tpu.cluster.local import LocalProcessBackend
 from tony_tpu.cluster.remote import LocalTransport, RemoteBackend, SshTransport
 from tony_tpu.cluster.tpu_vm import TpuVmBackend
@@ -17,8 +18,22 @@ def make_backend(name: str, config=None, **kwargs) -> ClusterBackend:
     """Backend factory keyed by the ``cluster.backend`` config value.
 
     ``config`` (a TonyConfig) supplies the remote backends' host list,
-    transport, and chip inventory; the local backend needs none of it.
+    transport, and chip inventory — and, for every backend, the shared
+    ResourceManager store (``cluster.rm_root``) that arbitrates capacity
+    across concurrently-submitted jobs.
     """
+    if config is not None:
+        from tony_tpu.config.keys import Keys
+
+        rm_root = config.get_str(Keys.CLUSTER_RM_ROOT, "")
+        if rm_root and "lease_store" not in kwargs:
+            from tony_tpu.cluster.lease import LeaseStore
+
+            kwargs["lease_store"] = LeaseStore(rm_root)
+        kwargs.setdefault(
+            "rm_queue_timeout_s",
+            config.get_float(Keys.AM_ALLOCATION_TIMEOUT_S, 300.0),
+        )
     if name == "local":
         return LocalProcessBackend(**kwargs)
     if name in ("remote", "tpu_vm"):
@@ -54,7 +69,9 @@ __all__ = [
     "Container",
     "ContainerRequest",
     "ContainerState",
+    "GangAsk",
     "InsufficientResources",
+    "LeaseStore",
     "LocalProcessBackend",
     "LocalTransport",
     "RemoteBackend",

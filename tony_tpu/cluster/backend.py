@@ -137,6 +137,25 @@ class ClusterBackend(Protocol):
         own footprint). Called once at AM startup."""
         ...
 
+    def reserve_job(
+        self,
+        asks: Sequence[tuple[Resource, str]],
+        *,
+        timeout_s: float = 0.0,
+        cancel: Callable[[], bool] | None = None,
+    ) -> None:
+        """Gang-reserve the job's ENTIRE container ask (one (resource,
+        node_label) pair per instance) before any allocate().
+
+        With a shared :class:`~tony_tpu.cluster.lease.LeaseStore` attached
+        this is the cross-job arbitration point — the YARN-RM analogue:
+        the whole gang is leased atomically (FIFO-queued behind earlier
+        jobs up to ``timeout_s``) so concurrent jobs cannot interleave into
+        deadlock or double-book TPU chips. Without a store it is a no-op:
+        the backend's private inventory is the only consumer. Idempotent —
+        gang restarts re-enter the same reservation."""
+        ...
+
     def kill_orphan(self, host: str, pid: int) -> None:
         """Kill a process group journalled by a previous AM attempt.
 

@@ -1,0 +1,496 @@
+"""Shared ResourceManager: cross-job lease arbitration over a file-locked store.
+
+The reference's L0 is YARN's ResourceManager — ONE daemon arbitrating every
+job's containers (SURVEY.md section 1 L0, section 3.1 ``YarnClient.
+createApplication`` / RM scheduling). Each tony-tpu AM instantiates its own
+backend, so without a shared authority two concurrent ``tony submit`` runs
+against the same hosts would each believe they own full capacity and
+double-book TPU chips. The :class:`LeaseStore` is that authority, rebuilt
+without a daemon: a directory on a filesystem every submitter can reach
+(same machine, or a shared FS across submit hosts), where every mutation is
+a read-modify-write of one JSON state file under an exclusive ``flock``.
+
+Grant discipline is **gang-atomic FIFO**: a job reserves its ENTIRE
+container ask as one ticket (``reserve_gang``), which is granted only when
+a feasible first-fit packing onto the registered hosts exists — so two
+concurrent jobs can never interleave partial allocations into a cross-job
+gang deadlock; the later job queues behind the earlier one (YARN FIFO
+scheduler semantics) and runs when capacity frees, or times out with a
+message naming the holders. Leases live for the job's duration (elastic
+gang restarts relaunch into the same reservation) and are dropped by
+``release_app`` at job end.
+
+Crash safety: every app's entry records its owner (submit host, pid, pid
+start time from ``/proc``); any later locked operation by a surviving
+process on the same host reaps apps whose owner process is gone — the
+recovery YARN gets from AM liveness tracking. Cross-host stale owners
+cannot be pid-checked; ``force_release_app`` (surfaced as
+``tony rm-status --release APP``) is the operator override.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import logging
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Iterator, Mapping, Sequence
+
+from tony_tpu.cluster.backend import InsufficientResources, Resource
+
+log = logging.getLogger(__name__)
+
+STATE_FILE = "state.json"
+LOCK_FILE = "lock"
+
+
+@dataclass(frozen=True)
+class GangAsk:
+    """One container-sized ask inside a gang reservation.
+
+    ``host`` pins the ask to a specific host (the AM-footprint case);
+    ``node_label`` restricts packing to hosts registered with that label;
+    ``candidates`` restricts packing to the asking job's OWN inventory —
+    the store may know hosts from other jobs' configs, and a lease on a
+    host this job cannot launch on would be capacity lost to everyone.
+    """
+
+    resource: Resource
+    node_label: str = ""
+    host: str = ""
+    candidates: tuple[str, ...] = ()
+
+    def allowed(self, host: str, label: str) -> bool:
+        if self.host:
+            return host == self.host
+        if self.candidates and host not in self.candidates:
+            return False
+        return not self.node_label or label == self.node_label
+
+    def to_json(self) -> dict:
+        r = self.resource
+        return {
+            "memory_mb": r.memory_mb,
+            "cpus": r.cpus,
+            "tpu_chips": r.tpu_chips,
+            "node_label": self.node_label,
+            "host": self.host,
+            "candidates": list(self.candidates),
+        }
+
+    @staticmethod
+    def from_json(d: Mapping) -> "GangAsk":
+        return GangAsk(
+            Resource(d["memory_mb"], d["cpus"], d["tpu_chips"]),
+            d.get("node_label", ""),
+            d.get("host", ""),
+            tuple(d.get("candidates", ())),
+        )
+
+
+def _pid_start_time(pid: int) -> int:
+    """Linux process start time (clock ticks since boot) — pid-reuse guard."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        # field 22, but the comm field (2) may contain spaces/parens: split
+        # after the LAST ')' so weird process names can't shift the fields
+        return int(stat.rsplit(")", 1)[1].split()[19])
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+def _pid_alive(pid: int, start_time: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        pass  # exists, owned by someone else
+    if start_time:
+        now = _pid_start_time(pid)
+        if now and now != start_time:
+            return False  # pid reused by a different process
+    return True
+
+
+class LeaseStore:
+    """File-locked cross-job inventory arbiter (see module docstring)."""
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        owner_host: str = "",
+        poll_interval_s: float = 0.1,
+    ):
+        self.root = os.path.abspath(os.path.expanduser(root))
+        os.makedirs(self.root, exist_ok=True)
+        self._lock_path = os.path.join(self.root, LOCK_FILE)
+        self._state_path = os.path.join(self.root, STATE_FILE)
+        self._owner_host = owner_host or _this_host()
+        self._poll_interval_s = poll_interval_s
+
+    # --- locked state access ------------------------------------------------
+
+    @contextmanager
+    def _locked(self) -> Iterator[dict]:
+        """EXCLUSIVE flock over load → mutate → atomic replace.
+
+        The state is persisted even when the body raises: rejection paths
+        mutate (dequeue their ticket) and then raise, and that dequeue must
+        land or the dead ticket would block the queue head forever.
+        """
+        with open(self._lock_path, "a+") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                before = ""
+                try:
+                    with open(self._state_path, "r") as f:
+                        before = f.read()
+                    state = json.loads(before)
+                except (FileNotFoundError, json.JSONDecodeError):
+                    state = {"hosts": {}, "apps": {}, "queue": [], "next_seq": 1}
+                self._reap_dead_owners(state)
+                try:
+                    yield state
+                finally:
+                    # skip the rewrite when nothing changed: queued waiters
+                    # poll under this lock every poll_interval, and a dirty
+                    # write per read-only poll would churn a shared-FS file
+                    after = json.dumps(state, indent=1)
+                    if after != before:
+                        tmp = self._state_path + ".tmp"
+                        with open(tmp, "w") as f:
+                            f.write(after)
+                        os.replace(tmp, self._state_path)
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+
+    def _reap_dead_owners(self, state: dict) -> None:
+        """Drop apps (leases) and queue tickets whose owner process is gone.
+
+        Only owners on THIS host can be liveness-checked; remote owners are
+        left alone (explicit release or operator override). Tickets carry
+        their own owner: a job that dies while QUEUED has no app entry yet,
+        and its stale ticket would block the FIFO head forever."""
+        dead = [
+            app_id
+            for app_id, app in state["apps"].items()
+            if app["owner_host"] == self._owner_host
+            and not _pid_alive(app["owner_pid"], app.get("owner_start", 0))
+        ]
+        for app_id in dead:
+            log.warning("reaping leases of dead app %s", app_id)
+            state["apps"].pop(app_id, None)
+        state["queue"] = [
+            t
+            for t in state["queue"]
+            if t["app_id"] not in dead
+            and not (
+                t.get("owner_host") == self._owner_host
+                and not _pid_alive(t.get("owner_pid", 0), t.get("owner_start", 0))
+            )
+        ]
+
+    # --- host registry ------------------------------------------------------
+
+    def register_hosts(
+        self, capacities: Mapping[str, Resource], labels: Mapping[str, str] | None = None
+    ) -> None:
+        """Union-register hosts. First registration pins a host's capacity;
+        a later conflicting capacity is IGNORED with a loud warning (the
+        conservative choice: silently widening a host that another job is
+        already leasing from would re-open double-booking)."""
+        labels = labels or {}
+        with self._locked() as state:
+            for host, cap in capacities.items():
+                entry = {
+                    "memory_mb": cap.memory_mb,
+                    "cpus": cap.cpus,
+                    "tpu_chips": cap.tpu_chips,
+                    "label": labels.get(host, ""),
+                }
+                existing = state["hosts"].get(host)
+                if existing is None:
+                    state["hosts"][host] = entry
+                elif existing != entry:
+                    log.warning(
+                        "host %s already registered as %s; keeping it "
+                        "(this job declared %s)", host, existing, entry,
+                    )
+
+    # --- gang reservation ---------------------------------------------------
+
+    def reserve_gang(
+        self,
+        app_id: str,
+        asks: Sequence[GangAsk],
+        *,
+        gang_id: str = "containers",
+        timeout_s: float = 0.0,
+        cancel: Callable[[], bool] | None = None,
+    ) -> list[tuple[GangAsk, str]]:
+        """Atomically lease capacity for every ask, or queue for it (FIFO).
+
+        Returns the packing ``[(ask, host), ...]``. Raises
+        :class:`InsufficientResources` when the gang cannot be granted
+        within ``timeout_s`` (0 = one immediate attempt), with a message
+        naming the current holders. Idempotent per (app_id, gang_id):
+        calling again returns the existing packing — gang restarts and AM
+        re-attempts re-enter the same reservation (``gang_id`` keeps an
+        app's distinct reservations — AM footprint vs containers — from
+        colliding when their asks happen to be equal).
+        """
+        asks = list(asks)
+        want = [a.to_json() for a in asks]
+        deadline = time.monotonic() + timeout_s
+        ticket_seq: int | None = None
+        while True:
+            with self._locked() as state:
+                app = state["apps"].get(app_id)
+                if app is not None:
+                    for gang in app["gangs"]:
+                        if gang["gang_id"] == gang_id:
+                            if gang["asks"] != want:
+                                raise LeaseStoreError(
+                                    f"gang {gang_id!r} of {app_id} already "
+                                    "reserved with different asks; release "
+                                    "the app before reshaping the job"
+                                )
+                            return [
+                                (a, h)
+                                for a, h in zip(asks, gang["hosts"])
+                            ]
+                if not state["hosts"]:
+                    raise LeaseStoreError(
+                        "no hosts registered in the lease store; call "
+                        "register_hosts() before reserve_gang()"
+                    )
+                infeasible = self._infeasible_reason(state, asks)
+                if infeasible:
+                    self._dequeue(state, app_id, ticket_seq)
+                    raise InsufficientResources(
+                        f"gang for {app_id} can never be placed: {infeasible}"
+                    )
+                if ticket_seq is None:
+                    ticket_seq = state["next_seq"]
+                    state["next_seq"] += 1
+                    state["queue"].append(
+                        {
+                            "seq": ticket_seq,
+                            "app_id": app_id,
+                            "asks": want,
+                            "owner_host": self._owner_host,
+                            "owner_pid": os.getpid(),
+                            "owner_start": _pid_start_time(os.getpid()),
+                        }
+                    )
+                elif not any(t["seq"] == ticket_seq for t in state["queue"]):
+                    # our ticket vanished without a grant: someone released
+                    # this app externally (tony rm-status --release) — a
+                    # clean rejection, not a crash
+                    raise InsufficientResources(
+                        f"gang for {app_id} was released externally while "
+                        "queued (operator rm-status --release?)"
+                    )
+                head = min(state["queue"], key=lambda t: t["seq"])
+                if head["seq"] == ticket_seq:
+                    packing = self._try_pack(state, asks)
+                    if packing is not None:
+                        self._dequeue(state, app_id, ticket_seq)
+                        self._commit(
+                            state, app_id, gang_id, want, packing,
+                            self._owner_host,
+                        )
+                        return list(zip(asks, packing))
+                expired = time.monotonic() >= deadline
+                cancelled = cancel is not None and cancel()
+                if expired or cancelled:
+                    holders = self._holders_summary(state, exclude=app_id)
+                    self._dequeue(state, app_id, ticket_seq)
+                    why = "cancelled" if cancelled else f"timed out ({timeout_s:.0f}s)"
+                    raise InsufficientResources(
+                        f"gang for {app_id} {why} waiting for capacity; "
+                        f"current holders: {holders or 'none (queued behind another job)'}"
+                    )
+            time.sleep(self._poll_interval_s)
+
+    @staticmethod
+    def _dequeue(state: dict, app_id: str, seq: int | None) -> None:
+        if seq is not None:
+            state["queue"] = [
+                t
+                for t in state["queue"]
+                if not (t["app_id"] == app_id and t["seq"] == seq)
+            ]
+
+    @staticmethod
+    def _commit(
+        state: dict, app_id: str, gang_id: str, want: list[dict],
+        packing: list[str], owner_host: str,
+    ) -> None:
+        app = state["apps"].setdefault(
+            app_id,
+            {
+                "owner_host": owner_host,
+                "owner_pid": os.getpid(),
+                "owner_start": _pid_start_time(os.getpid()),
+                "gangs": [],
+            },
+        )
+        app["gangs"].append(
+            {
+                "gang_id": gang_id,
+                "asks": want,
+                "hosts": packing,
+                "granted_at": time.time(),
+            }
+        )
+
+    # --- packing ------------------------------------------------------------
+
+    def _host_available(self, state: dict) -> dict[str, Resource]:
+        avail = {
+            h: Resource(e["memory_mb"], e["cpus"], e["tpu_chips"])
+            for h, e in state["hosts"].items()
+        }
+        for app in state["apps"].values():
+            for gang in app["gangs"]:
+                for ask, host in zip(gang["asks"], gang["hosts"]):
+                    if host in avail:
+                        avail[host] = avail[host] - GangAsk.from_json(ask).resource
+        return avail
+
+    def _try_pack(self, state: dict, asks: Sequence[GangAsk]) -> list[str] | None:
+        """First-fit packing of the whole gang against current availability,
+        hosts in registration order (matches RemoteBackend placement order).
+        Returns per-ask hosts, or None if the gang does not fit NOW."""
+        avail = self._host_available(state)
+        packing: list[str] = []
+        for ask in asks:
+            placed = ""
+            for h, entry in state["hosts"].items():
+                if not ask.allowed(h, entry["label"]):
+                    continue
+                if ask.resource.fits_in(avail[h]):
+                    avail[h] = avail[h] - ask.resource
+                    placed = h
+                    break
+            if not placed:
+                return None
+            packing.append(placed)
+        return packing
+
+    def _infeasible_reason(self, state: dict, asks: Sequence[GangAsk]) -> str:
+        """A gang that cannot fit even an EMPTY cluster should fail fast,
+        not queue until timeout."""
+        empty = {
+            h: Resource(e["memory_mb"], e["cpus"], e["tpu_chips"])
+            for h, e in state["hosts"].items()
+        }
+        for ask in asks:
+            if not any(
+                ask.allowed(h, state["hosts"][h]["label"])
+                and ask.resource.fits_in(empty[h])
+                for h in empty
+            ):
+                return (
+                    f"ask {ask.resource} (label={ask.node_label!r}, "
+                    f"host={ask.host!r}) fits no registered host even when idle"
+                )
+        # aggregate bound: the whole gang vs whole cluster (first-fit on an
+        # empty cluster is not simulated exactly; the per-ask check plus the
+        # aggregate bound catches the common impossibilities fast)
+        total = Resource(0, 0, 0)
+        for a in asks:
+            total = total + a.resource
+        cap = Resource(0, 0, 0)
+        for r in empty.values():
+            cap = cap + r
+        if not total.fits_in(cap):
+            return f"gang total {total} exceeds cluster capacity {cap}"
+        return ""
+
+    def _holders_summary(self, state: dict, exclude: str = "") -> str:
+        parts = []
+        for app_id, app in state["apps"].items():
+            if app_id == exclude:
+                continue
+            total = Resource(0, 0, 0)
+            n = 0
+            for gang in app["gangs"]:
+                for ask in gang["asks"]:
+                    total = total + GangAsk.from_json(ask).resource
+                    n += 1
+            parts.append(
+                f"{app_id} holds {n} leases ({total}) from "
+                f"{app['owner_host']}:{app['owner_pid']}"
+            )
+        return "; ".join(parts)
+
+    # --- release / inspection ----------------------------------------------
+
+    def release_app(self, app_id: str) -> None:
+        with self._locked() as state:
+            state["apps"].pop(app_id, None)
+            state["queue"] = [t for t in state["queue"] if t["app_id"] != app_id]
+
+    # operator override for cross-host stale owners (cannot be pid-checked)
+    force_release_app = release_app
+
+    def available(self) -> dict[str, Resource]:
+        with self._locked() as state:
+            return self._host_available(state)
+
+    def summary(self) -> dict:
+        """Snapshot for `tony rm-status`: hosts, per-app leases, queue."""
+        with self._locked() as state:
+            avail = self._host_available(state)
+            return {
+                "root": self.root,
+                "hosts": {
+                    h: {
+                        **e,
+                        "available": {
+                            "memory_mb": avail[h].memory_mb,
+                            "cpus": avail[h].cpus,
+                            "tpu_chips": avail[h].tpu_chips,
+                        },
+                    }
+                    for h, e in state["hosts"].items()
+                },
+                "apps": {
+                    app_id: {
+                        "owner": f"{a['owner_host']}:{a['owner_pid']}",
+                        "leases": [
+                            # granted host LAST so it wins over the ask's
+                            # own (usually empty) pin field
+                            {**ask, "host": h}
+                            for g in a["gangs"]
+                            for ask, h in zip(g["asks"], g["hosts"])
+                        ],
+                    }
+                    for app_id, a in state["apps"].items()
+                },
+                "queue": [
+                    {"seq": t["seq"], "app_id": t["app_id"], "asks": len(t["asks"])}
+                    for t in sorted(state["queue"], key=lambda t: t["seq"])
+                ],
+            }
+
+
+class LeaseStoreError(RuntimeError):
+    """Misuse of the store (e.g. reserving before registering hosts)."""
+
+
+def _this_host() -> str:
+    import socket
+
+    return socket.gethostname()
+
+
+__all__ = ["GangAsk", "LeaseStore", "LeaseStoreError"]

@@ -31,10 +31,30 @@ log = logging.getLogger(__name__)
 
 
 class LocalProcessBackend(_InventoryMixin):
-    """Subprocess containers against a fake, fixed inventory."""
+    """Subprocess containers against a fake, fixed inventory.
 
-    def __init__(self, capacity: Resource | None = None):
+    With a shared :class:`~tony_tpu.cluster.lease.LeaseStore` attached
+    (``cluster.rm_root``), the inventory is arbitrated ACROSS jobs: this
+    host registers once in the store, and every claim — the AM footprint
+    via :meth:`reserve` and the container gang via :meth:`reserve_job` —
+    is leased there first, so two concurrent submits on the same machine
+    queue FIFO instead of double-booking (the YARN-RM role the per-process
+    inventory alone cannot play)."""
+
+    def __init__(
+        self,
+        capacity: Resource | None = None,
+        *,
+        lease_store=None,
+        app_id: str = "",
+        rm_queue_timeout_s: float = 300.0,
+    ):
         super().__init__(capacity or Resource(memory_mb=1 << 20, cpus=256, tpu_chips=64))
+        self._store = lease_store
+        self._app_id = app_id or f"local-{os.getpid()}"
+        self._rm_queue_timeout_s = rm_queue_timeout_s
+        self._job_budget = Resource(0, 0, 0)  # store-granted capacity
+        self._reserved_gangs: set[tuple] = set()
         self._containers: dict[str, Container] = {}
         self._procs: dict[str, subprocess.Popen] = {}
         self._logs: dict[str, IO[bytes]] = {}
@@ -47,6 +67,55 @@ class LocalProcessBackend(_InventoryMixin):
 
     def start(self) -> None:
         self._stopped = False
+        if self._store is not None:
+            self._store.register_hosts({local_host(): self._capacity})
+
+    # --- shared-RM integration ---------------------------------------------
+
+    def _store_acquire(
+        self, gang_id: str, resources, timeout_s: float, cancel=None
+    ) -> None:
+        """Lease through the shared store and widen this job's budget once
+        per gang_id (the store itself is idempotent across AM restarts)."""
+        from tony_tpu.cluster.lease import GangAsk
+
+        if gang_id in self._reserved_gangs:
+            return
+        gang = [GangAsk(r, host=local_host()) for r in resources]
+        self._store.reserve_gang(
+            self._app_id, gang, gang_id=gang_id, timeout_s=timeout_s,
+            cancel=cancel,
+        )
+        self._reserved_gangs.add(gang_id)
+        with self._inv_lock:
+            for a in gang:
+                self._job_budget = self._job_budget + a.resource
+
+    def reserve_job(self, asks, *, timeout_s: float = 0.0, cancel=None) -> None:
+        if self._store is None:
+            return
+        self._store_acquire(
+            "containers", [r for r, _ in asks],
+            timeout_s or self._rm_queue_timeout_s, cancel,
+        )
+
+    def reserve(self, r: Resource) -> None:
+        if self._store is not None:
+            # AM footprint through the same arbiter as every container
+            self._store_acquire("am", [r], self._rm_queue_timeout_s)
+        super().reserve(r)
+
+    def _budget_guard(self, r: Resource, task_id: str) -> None:
+        """In shared-RM mode a container may only consume store-leased
+        budget; anything beyond it takes an on-demand single lease (an
+        immediate grant-or-raise, so an un-reserved direct allocate still
+        works when the cluster is idle but can never double-book)."""
+        if self._store is None:
+            return
+        with self._inv_lock:
+            short = not (self._in_use + r).fits_in(self._job_budget)
+        if short:
+            self._store_acquire(f"ondemand:{task_id}", [r], 0.0)
 
     def am_advertise_host(self) -> str:
         # Containers are subprocesses on this host; loopback is correct.
@@ -74,6 +143,7 @@ class LocalProcessBackend(_InventoryMixin):
                 f"LocalProcessBackend has no node labels (asked {request.node_label!r}); "
                 "use cluster.backend='remote' for labelled placement"
             )
+        self._budget_guard(request.resource, request.task_id)
         self._claim(request.resource)
         try:
             with self._lock:
@@ -171,6 +241,12 @@ class LocalProcessBackend(_InventoryMixin):
             self._kill(self._procs[cid])
         for cid, t in list(self._waiters.items()):
             t.join(timeout=10)
+        if self._store is not None:
+            # the job is over: hand every lease back to the shared RM
+            self._store.release_app(self._app_id)
+            self._reserved_gangs.clear()
+            with self._inv_lock:
+                self._job_budget = Resource(0, 0, 0)
 
     def containers(self) -> list[Container]:
         with self._lock:

@@ -299,9 +299,14 @@ class _HostSlot:
     capacity: Resource
     in_use: Resource = field(default_factory=lambda: Resource(0, 0, 0))
     label: str = ""
+    # shared-RM mode: the slice of this host the job actually LEASED from
+    # the cross-job store; placement is capped by it (None = no store, the
+    # whole host belongs to this job's private inventory)
+    budget: Resource | None = None
 
     def available(self) -> Resource:
-        return self.capacity - self.in_use
+        cap = self.capacity if self.budget is None else self.budget
+        return cap - self.in_use
 
 
 class RemoteBackend:
@@ -322,12 +327,25 @@ class RemoteBackend:
         host_labels: Mapping[str, str] | None = None,
         localize: bool = False,
         localize_root: str = "",
+        lease_store=None,
+        app_id: str = "",
+        rm_queue_timeout_s: float = 300.0,
     ):
         if not hosts:
             raise ValueError("RemoteBackend needs at least one host (cluster.hosts)")
         cap = host_capacity or Resource(memory_mb=1 << 20, cpus=256, tpu_chips=4)
+        self._store = lease_store
+        self._app_id = app_id or f"remote-{os.getpid()}"
+        self._rm_queue_timeout_s = rm_queue_timeout_s
+        self._reserved_gangs: set[str] = set()
         self._hosts = [
-            _HostSlot(h, cap, label=(host_labels or {}).get(h, "")) for h in hosts
+            _HostSlot(
+                h,
+                cap,
+                label=(host_labels or {}).get(h, ""),
+                budget=None if lease_store is None else Resource(0, 0, 0),
+            )
+            for h in hosts
         ]
         self.transport: Transport = (
             make_transport(transport) if isinstance(transport, str) else transport
@@ -362,6 +380,54 @@ class RemoteBackend:
 
     def start(self) -> None:
         self._stopped = False
+        if self._store is not None:
+            names = [s.host for s in self._hosts]
+            if len(set(names)) != len(names):
+                log.warning(
+                    "cluster.hosts repeats a hostname; the shared RM store "
+                    "keys inventory by name, so duplicates collapse to ONE "
+                    "host's capacity (conservative, but less than you "
+                    "configured)"
+                )
+            self._store.register_hosts(
+                {s.host: s.capacity for s in self._hosts},
+                {s.host: s.label for s in self._hosts if s.label},
+            )
+
+    # --- shared-RM integration ---------------------------------------------
+
+    def _store_acquire(
+        self, gang_id: str, gang, timeout_s: float, cancel=None
+    ) -> None:
+        """Lease a gang through the shared store and widen the per-host
+        budgets by the returned packing — once per gang_id (the store is
+        idempotent across AM re-attempts, returning the same packing)."""
+        if gang_id in self._reserved_gangs:
+            return
+        packing = self._store.reserve_gang(
+            self._app_id, gang, gang_id=gang_id, timeout_s=timeout_s,
+            cancel=cancel,
+        )
+        self._reserved_gangs.add(gang_id)
+        with self._lock:
+            by_host = {s.host: s for s in self._hosts}
+            for ask, host in packing:
+                slot = by_host.get(host)
+                if slot is not None and slot.budget is not None:
+                    slot.budget = slot.budget + ask.resource
+
+    def reserve_job(self, asks, *, timeout_s: float = 0.0, cancel=None) -> None:
+        if self._store is None:
+            return
+        from tony_tpu.cluster.lease import GangAsk
+
+        mine = tuple(s.host for s in self._hosts)
+        gang = [
+            GangAsk(r, node_label=label, candidates=mine) for r, label in asks
+        ]
+        self._store_acquire(
+            "containers", gang, timeout_s or self._rm_queue_timeout_s, cancel
+        )
 
     def am_advertise_host(self) -> str:
         # remote executors must dial back across the network, never loopback
@@ -392,24 +458,41 @@ class RemoteBackend:
     def reserve(self, r: Resource) -> None:
         """AM footprint. When this machine is part of the inventory (some
         configured host resolves as local), the AM's resources come out of
-        that host's capacity like any container. Otherwise the AM runs
-        OFF-inventory (the usual pod-slice layout: AM on the coordinator VM,
-        workers on the slice) and its footprint is not counted — stated out
-        loud so gang-allocation math never silently drifts."""
+        that host's capacity like any container — leased through the shared
+        store first when one is attached, so even the AM's slice is
+        arbitrated cross-job. Otherwise the AM runs OFF-inventory (the
+        usual pod-slice layout: AM on the coordinator VM, workers on the
+        slice) and its footprint is not counted — stated out loud so
+        gang-allocation math never silently drifts."""
         with self._lock:
-            for s in self._hosts:
-                if s.host in ("127.0.0.1", "localhost", local_host()):
-                    if r.fits_in(s.available()):
-                        s.in_use = s.in_use + r
-                    else:
-                        log.warning(
-                            "AM footprint %s does not fit host %s; "
-                            "not accounted", r, s.host,
-                        )
-                    return
-        log.info(
-            "AM host not in cluster.hosts; AM footprint %s runs off-inventory", r
-        )
+            am_slot = next(
+                (
+                    s
+                    for s in self._hosts
+                    if s.host in ("127.0.0.1", "localhost", local_host())
+                ),
+                None,
+            )
+        if am_slot is None:
+            log.info(
+                "AM host not in cluster.hosts; AM footprint %s runs "
+                "off-inventory", r,
+            )
+            return
+        if self._store is not None:
+            from tony_tpu.cluster.lease import GangAsk
+
+            self._store_acquire(
+                "am", [GangAsk(r, host=am_slot.host)], self._rm_queue_timeout_s
+            )
+        with self._lock:
+            if r.fits_in(am_slot.available()):
+                am_slot.in_use = am_slot.in_use + r
+            else:
+                log.warning(
+                    "AM footprint %s does not fit host %s; not accounted",
+                    r, am_slot.host,
+                )
 
     def _place(self, request: ContainerRequest) -> _HostSlot:
         if request.node_label and not any(
@@ -429,11 +512,36 @@ class RemoteBackend:
     def allocate(self, request: ContainerRequest) -> Container:
         if self._stopped:
             raise InsufficientResources("backend stopped")
-        with self._lock:
-            slot = self._place(request)
-            slot.in_use = slot.in_use + request.resource
-            self._next_id += 1
-            cid = f"container_{self._next_id:06d}"
+        try:
+            with self._lock:
+                slot = self._place(request)
+                slot.in_use = slot.in_use + request.resource
+                self._next_id += 1
+                cid = f"container_{self._next_id:06d}"
+        except InsufficientResources:
+            if self._store is None:
+                raise
+            # shared-RM mode without a covering reservation (direct
+            # allocate, or a job grown past its gang): take an on-demand
+            # single lease — immediate grant-or-raise, never double-booked
+            from tony_tpu.cluster.lease import GangAsk
+
+            self._store_acquire(
+                f"ondemand:{request.task_id}",
+                [
+                    GangAsk(
+                        request.resource,
+                        node_label=request.node_label,
+                        candidates=tuple(s.host for s in self._hosts),
+                    )
+                ],
+                0.0,
+            )
+            with self._lock:
+                slot = self._place(request)
+                slot.in_use = slot.in_use + request.resource
+                self._next_id += 1
+                cid = f"container_{self._next_id:06d}"
         if request.log_path:
             os.makedirs(os.path.dirname(request.log_path) or ".", exist_ok=True)
             out: IO[bytes] = open(request.log_path, "ab")
@@ -579,6 +687,13 @@ class RemoteBackend:
                 self.transport.kill_pg(c.host, proc.pid, signal.SIGKILL)
         for t in list(self._waiters.values()):
             t.join(timeout=10)
+        if self._store is not None:
+            # the job is over: hand every lease back to the shared RM
+            self._store.release_app(self._app_id)
+            self._reserved_gangs.clear()
+            with self._lock:
+                for s in self._hosts:
+                    s.budget = Resource(0, 0, 0)
 
     def containers(self) -> list[Container]:
         with self._lock:

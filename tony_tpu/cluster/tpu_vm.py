@@ -51,6 +51,9 @@ class TpuVmBackend(RemoteBackend):
         transport: Transport | str = "ssh",
         localize: bool = False,
         localize_root: str = "",
+        lease_store=None,
+        app_id: str = "",
+        rm_queue_timeout_s: float = 300.0,
     ):
         self.accelerator_type = accelerator_type
         self.zone = zone
@@ -65,6 +68,9 @@ class TpuVmBackend(RemoteBackend):
             host_capacity=Resource(memory_mb=1 << 20, cpus=256, tpu_chips=chips),
             localize=localize,
             localize_root=localize_root,
+            lease_store=lease_store,
+            app_id=app_id,
+            rm_queue_timeout_s=rm_queue_timeout_s,
         )
 
     def _discover_hosts(self) -> list[str]:

@@ -71,6 +71,11 @@ class Keys:
     # destination root for localized app dirs (default ~/.tony-tpu/localized,
     # expanded on the AM host — assumes the same home path on every host)
     CLUSTER_LOCALIZE_ROOT = "cluster.localize_root"
+    # shared ResourceManager (YARN-RM analogue): a directory reachable by
+    # every submitter (same machine or shared FS); when set, all jobs lease
+    # capacity from this file-locked store, so concurrent submits queue
+    # FIFO instead of double-booking hosts/chips. Empty = per-job inventory.
+    CLUSTER_RM_ROOT = "cluster.rm_root"
 
     # --- portal/history ---
     HISTORY_INTERMEDIATE_DIR = "history.intermediate_dir"
@@ -141,6 +146,7 @@ DEFAULTS: dict[str, object] = {
     Keys.CLUSTER_REMOTE_TRANSPORT: "ssh",
     Keys.CLUSTER_LOCALIZE: False,
     Keys.CLUSTER_LOCALIZE_ROOT: "",
+    Keys.CLUSTER_RM_ROOT: "",
     Keys.HISTORY_INTERMEDIATE_DIR: "",
     Keys.HISTORY_FINISHED_DIR: "",
     Keys.PORTAL_PORT: 8080,
