@@ -646,3 +646,39 @@ def test_concurrent_job_clean_rejection_names_holder(tmp_path):
     assert status["state"] == "FAILED"
     ta.join(90)
     assert results["rm-holder"][0] == 0
+
+
+def test_submit_latency_breakdown_recorded(tmp_path):
+    """The second north-star metric (BASELINE.json "metric"): submit ->
+    first-step latency is measurable from any fit() job's artifacts —
+    submitted_at written by the client, the first step-carrying METRICS
+    event timestamped by the AM (bypassing the history throttle), and
+    submit_latency() assembling the phase breakdown."""
+    from tony_tpu.am.events import submit_latency
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "train.py").write_text(
+        "from tony_tpu.train import fit, FitConfig\n"
+        "from tony_tpu.train.data import DataConfig\n"
+        "from tony_tpu.models.llama import LlamaConfig\n"
+        "fit(FitConfig(model=LlamaConfig.tiny(),\n"
+        "    data=DataConfig(global_batch=8, seq_len=32, vocab_size=128),\n"
+        "    steps=3, log_every=10))\n"  # log_every > steps: step-1 push must still happen
+    )
+    code, app_dir = submit(
+        tmp_path,
+        {
+            "application.name": "latency",
+            "application.framework": "jax",
+            "job.worker.instances": 1,
+            "job.worker.command": f"{sys.executable} train.py",
+            "job.worker.env": ["JAX_PLATFORMS=cpu"],
+        },
+        src_dir=str(src),
+    )
+    assert code == 0
+    lat = submit_latency(app_dir)
+    # phases are present, ordered, and positive
+    assert 0 < lat["am_inited_s"] <= lat["task_started_s"] <= lat["registered_s"]
+    assert lat["registered_s"] < lat["first_step_s"] < 120

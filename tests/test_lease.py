@@ -362,3 +362,40 @@ def test_summary_reports_granted_host(tmp_path):
     s.reserve_gang("app", [GangAsk(res(2))], timeout_s=0)
     leases = s.summary()["apps"]["app"]["leases"]
     assert leases[0]["host"] == "h1"
+
+
+def test_remote_placement_honors_store_packing(tmp_path):
+    """A job whose cluster.hosts order differs from the store's
+    registration order must place each container on the host the store
+    PACKED its ask onto — greedy re-packing over budgets would strand the
+    big ask (2-chip ask stealing the 4-chip ask's host)."""
+    from tony_tpu.cluster.backend import ContainerRequest
+    from tony_tpu.cluster.remote import LocalTransport, RemoteBackend
+
+    # job A fixes the store's registration order: h1 then h2
+    store(tmp_path).register_hosts(
+        {"h1": res(4, 4096, 16), "h2": res(4, 4096, 16)}
+    )
+    b = RemoteBackend(
+        ["h2", "h1"],  # opposite order to the store
+        transport=LocalTransport(),
+        host_capacity=res(4, 4096, 16),
+        lease_store=store(tmp_path),
+        app_id="job-b",
+    )
+    b.start()
+    b.reserve_job([(res(2), ""), (res(4), "")], timeout_s=5)
+
+    def creq(i, chips):
+        return ContainerRequest(
+            task_type="w", task_index=i, resource=res(chips),
+            argv=[sys.executable, "-c", "import time; time.sleep(20)"],
+            env={}, log_path=str(tmp_path / f"c{i}.log"),
+        )
+
+    c_small = b.allocate(creq(0, 2))
+    c_big = b.allocate(creq(1, 4))  # must not be stranded
+    # store packs first-fit in ITS order: 2-chip -> h1, 4-chip -> h2
+    assert c_small.host == "h1"
+    assert c_big.host == "h2"
+    b.stop()

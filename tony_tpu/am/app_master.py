@@ -86,6 +86,7 @@ class ApplicationMaster(ApplicationRpcServicer):
         self._max_restarts = config.get_int(Keys.RESTART_MAX_WORKER_RESTARTS, 0)
         self._latest_metrics: dict[str, dict[str, float]] = {}
         self._last_metrics_event: dict[str, float] = {}
+        self._step_metric_seen: set[str] = set()
         self._metrics_event_min_interval_s = 30.0
         self._scheduler_mode = config.get_str(Keys.SCHEDULER_MODE, "GANG").upper()
         # serializes am.state.json writes (scheduler + supervise threads)
@@ -224,8 +225,19 @@ class ApplicationMaster(ApplicationRpcServicer):
         # samples nest under their own key (names are user-chosen and must
         # not collide with the event envelope), and emission is throttled
         # per task so long jobs don't grow the history file without bound.
+        # a task's FIRST step-carrying sample bypasses the throttle: it is
+        # the submit->first-step latency timestamp (north-star metric), and
+        # a monitor rss sample arriving earlier must not eat its history
+        # slot. Later step pushes obey the throttle — the unbounded-history
+        # guard stays intact for long jobs.
         now = time.monotonic()
-        if now - self._last_metrics_event.get(tid, 0.0) >= self._metrics_event_min_interval_s:
+        first_step = "step" in samples and tid not in self._step_metric_seen
+        if first_step:
+            self._step_metric_seen.add(tid)
+        if first_step or (
+            now - self._last_metrics_event.get(tid, 0.0)
+            >= self._metrics_event_min_interval_s
+        ):
             self._last_metrics_event[tid] = now
             self.events.emit(EventType.METRICS, task=tid, samples=samples)
         return pb.Empty()

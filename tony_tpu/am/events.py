@@ -89,6 +89,77 @@ class EventWriter:
         return self._path
 
 
+def submit_latency(app_dir: str) -> dict[str, float]:
+    """AM-submit -> first-training-step latency, with a phase breakdown.
+
+    The north-star latency metric (SURVEY.md section 3.1: "the only
+    latency-critical path is submit -> first-step"): wall time from the
+    client's submit moment (written to ``<app_dir>/submitted_at`` before
+    staging) to the AM's first METRICS event carrying a ``step`` sample
+    (fit() pushes one after the very first optimizer step). Phases:
+
+    - ``am_inited_s``    — staging + AM process boot (APPLICATION_INITED)
+    - ``task_started_s`` — + container allocation/launch (first TASK_STARTED)
+    - ``registered_s``   — + executor boot/registration (first TASK_REGISTERED)
+    - ``first_step_s``   — + gang barrier, jax/dist init, compile, step 1
+
+    Raises ``FileNotFoundError``/``ValueError`` when the app dir predates
+    this instrumentation or no step metric was ever pushed.
+    """
+    with open(os.path.join(app_dir, "submitted_at")) as f:
+        t0 = json.load(f)["ts"]
+    events = read_history(_find_history_file(app_dir))
+    out: dict[str, float] = {}
+
+    def first(pred, key):
+        for e in events:
+            if pred(e):
+                out[key] = round(e["ts"] - t0, 3)
+                return
+    first(lambda e: e["type"] == EventType.APPLICATION_INITED, "am_inited_s")
+    first(lambda e: e["type"] == EventType.TASK_STARTED, "task_started_s")
+    first(lambda e: e["type"] == EventType.TASK_REGISTERED, "registered_s")
+    first(
+        lambda e: e["type"] == EventType.METRICS
+        and e.get("samples", {}).get("step", 0) >= 1,
+        "first_step_s",
+    )
+    if "first_step_s" not in out:
+        raise ValueError(
+            f"no step METRICS event in {app_dir} (job not using fit(), or "
+            "it never completed a step)"
+        )
+    return out
+
+
+def _find_history_file(app_dir: str) -> str:
+    """Locate the app's .jhist.jsonl: the AM writes it to
+    history.intermediate_dir (from the app's own config.json) and moves it
+    to history.finished_dir on close, defaulting to <app_dir>/events —
+    check all three so configured-portal jobs resolve too."""
+    app_id = os.path.basename(os.path.abspath(app_dir).rstrip("/"))
+    candidates = [os.path.join(app_dir, "events")]
+    cfg_path = os.path.join(app_dir, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        for key in ("history.finished_dir", "history.intermediate_dir"):
+            d = cfg.get(key)
+            if d:
+                candidates.insert(0, d)
+    for d in candidates:
+        path = os.path.join(d, f"{app_id}.jhist.jsonl")
+        if os.path.exists(path):
+            return path
+        if os.path.isdir(d):  # unknown app-id naming: any single history file
+            files = [f for f in os.listdir(d) if f.endswith(".jhist.jsonl")]
+            if len(files) == 1:
+                return os.path.join(d, files[0])
+    raise FileNotFoundError(
+        f"no history file for {app_id} under any of {candidates}"
+    )
+
+
 def read_history(path: str) -> list[dict[str, Any]]:
     """Parse a .jhist.jsonl file (portal read path)."""
     events = []
@@ -100,4 +171,4 @@ def read_history(path: str) -> list[dict[str, Any]]:
     return events
 
 
-__all__ = ["EventType", "EventWriter", "read_history"]
+__all__ = ["EventType", "EventWriter", "read_history", "submit_latency"]

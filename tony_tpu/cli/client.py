@@ -215,7 +215,10 @@ class TonyClient:
 
     def run(self, quiet: bool = False) -> int:
         """stage -> launch AM -> monitor -> exit code (TonyClient.run analogue)."""
+        submitted_at = time.time()  # BEFORE staging: staging is part of the cost
         self.stage()
+        with open(os.path.join(self.app_dir, "submitted_at"), "w") as f:
+            json.dump({"ts": submitted_at}, f)
         self.launch_am()
         return self.monitor(quiet=quiet)
 

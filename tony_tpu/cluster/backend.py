@@ -141,7 +141,7 @@ class ClusterBackend(Protocol):
         self,
         asks: Sequence[tuple[Resource, str]],
         *,
-        timeout_s: float = 0.0,
+        timeout_s: float | None = None,
         cancel: Callable[[], bool] | None = None,
     ) -> None:
         """Gang-reserve the job's ENTIRE container ask (one (resource,
@@ -150,10 +150,12 @@ class ClusterBackend(Protocol):
         With a shared :class:`~tony_tpu.cluster.lease.LeaseStore` attached
         this is the cross-job arbitration point — the YARN-RM analogue:
         the whole gang is leased atomically (FIFO-queued behind earlier
-        jobs up to ``timeout_s``) so concurrent jobs cannot interleave into
-        deadlock or double-book TPU chips. Without a store it is a no-op:
-        the backend's private inventory is the only consumer. Idempotent —
-        gang restarts re-enter the same reservation."""
+        jobs up to ``timeout_s``: None = the backend's configured queue
+        timeout, 0 = one immediate attempt) so concurrent jobs cannot
+        interleave into deadlock or double-book TPU chips. Without a store
+        it is a no-op: the backend's private inventory is the only
+        consumer. Idempotent — gang restarts re-enter the same
+        reservation."""
         ...
 
     def kill_orphan(self, host: str, pid: int) -> None:

@@ -91,13 +91,12 @@ class LocalProcessBackend(_InventoryMixin):
             for a in gang:
                 self._job_budget = self._job_budget + a.resource
 
-    def reserve_job(self, asks, *, timeout_s: float = 0.0, cancel=None) -> None:
+    def reserve_job(self, asks, *, timeout_s: float | None = None, cancel=None) -> None:
         if self._store is None:
             return
-        self._store_acquire(
-            "containers", [r for r, _ in asks],
-            timeout_s or self._rm_queue_timeout_s, cancel,
-        )
+        if timeout_s is None:
+            timeout_s = self._rm_queue_timeout_s
+        self._store_acquire("containers", [r for r, _ in asks], timeout_s, cancel)
 
     def reserve(self, r: Resource) -> None:
         if self._store is not None:

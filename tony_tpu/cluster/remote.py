@@ -338,6 +338,10 @@ class RemoteBackend:
         self._app_id = app_id or f"remote-{os.getpid()}"
         self._rm_queue_timeout_s = rm_queue_timeout_s
         self._reserved_gangs: set[str] = set()
+        # store-packed container slots: [resource, node_label, host,
+        # claimed_by_cid] — allocate() claims a matching slot and launches
+        # on ITS host, never re-packing greedily (see _store_acquire)
+        self._gang_slots: list[list] = []
         self._hosts = [
             _HostSlot(
                 h,
@@ -399,9 +403,12 @@ class RemoteBackend:
     def _store_acquire(
         self, gang_id: str, gang, timeout_s: float, cancel=None
     ) -> None:
-        """Lease a gang through the shared store and widen the per-host
-        budgets by the returned packing — once per gang_id (the store is
-        idempotent across AM re-attempts, returning the same packing)."""
+        """Lease a gang through the shared store: widen the per-host budgets
+        AND record the per-ask packing slots — placement must honor the
+        store's packing exactly (a greedy re-pack over budgets can strand
+        capacity: a small ask landing on the host the store packed a big
+        ask onto leaves the big ask unplaceable). Once per gang_id (the
+        store is idempotent across AM re-attempts)."""
         if gang_id in self._reserved_gangs:
             return
         packing = self._store.reserve_gang(
@@ -415,8 +422,13 @@ class RemoteBackend:
                 slot = by_host.get(host)
                 if slot is not None and slot.budget is not None:
                     slot.budget = slot.budget + ask.resource
+                if gang_id == "containers":
+                    # container asks become claimable placement slots
+                    self._gang_slots.append(
+                        [ask.resource, ask.node_label, host, ""]
+                    )
 
-    def reserve_job(self, asks, *, timeout_s: float = 0.0, cancel=None) -> None:
+    def reserve_job(self, asks, *, timeout_s: float | None = None, cancel=None) -> None:
         if self._store is None:
             return
         from tony_tpu.cluster.lease import GangAsk
@@ -425,9 +437,9 @@ class RemoteBackend:
         gang = [
             GangAsk(r, node_label=label, candidates=mine) for r, label in asks
         ]
-        self._store_acquire(
-            "containers", gang, timeout_s or self._rm_queue_timeout_s, cancel
-        )
+        if timeout_s is None:
+            timeout_s = self._rm_queue_timeout_s
+        self._store_acquire("containers", gang, timeout_s, cancel)
 
     def am_advertise_host(self) -> str:
         # remote executors must dial back across the network, never loopback
@@ -509,15 +521,29 @@ class RemoteBackend:
             f"no host fits {request.resource} (label={request.node_label!r})"
         )
 
+    def _claim_gang_slot(self, request: ContainerRequest, cid: str) -> _HostSlot | None:
+        """Claim a store-packed container slot matching (resource, label);
+        returns its host's _HostSlot, or None when no gang slot matches.
+        Caller holds self._lock."""
+        for gs in self._gang_slots:
+            if gs[3] == "" and gs[0] == request.resource and gs[1] == request.node_label:
+                for s in self._hosts:
+                    if s.host == gs[2]:
+                        gs[3] = cid
+                        return s
+        return None
+
     def allocate(self, request: ContainerRequest) -> Container:
         if self._stopped:
             raise InsufficientResources("backend stopped")
         try:
             with self._lock:
-                slot = self._place(request)
-                slot.in_use = slot.in_use + request.resource
                 self._next_id += 1
                 cid = f"container_{self._next_id:06d}"
+                slot = self._claim_gang_slot(request, cid)
+                if slot is None:
+                    slot = self._place(request)
+                slot.in_use = slot.in_use + request.resource
         except InsufficientResources:
             if self._store is None:
                 raise
@@ -557,6 +583,7 @@ class RemoteBackend:
             out.close()
             with self._lock:
                 slot.in_use = slot.in_use - request.resource
+                self._unclaim_gang_slot(cid)
             raise
         container = Container(
             container_id=cid,
@@ -582,6 +609,15 @@ class RemoteBackend:
             cid, request.task_id, slot.host, proc.pid,
         )
         return container
+
+    def _unclaim_gang_slot(self, cid: str) -> None:
+        """Free the gang slot a finished/failed container claimed, so a
+        gang-restart relaunch lands on the same store-packed host. Caller
+        holds self._lock."""
+        for gs in self._gang_slots:
+            if gs[3] == cid:
+                gs[3] = ""
+                return
 
     def _localize_app(self, host: str, env: dict) -> None:
         """Copy the app dir to ``host`` once per (host, app) and point the
@@ -638,6 +674,7 @@ class RemoteBackend:
             )
             slot = self._slot_of[cid]
             slot.in_use = slot.in_use - container.resource
+            self._unclaim_gang_slot(cid)
             logf = self._logs.pop(cid, None)
         if logf is not None:
             try:
@@ -692,6 +729,7 @@ class RemoteBackend:
             self._store.release_app(self._app_id)
             self._reserved_gangs.clear()
             with self._lock:
+                self._gang_slots.clear()
                 for s in self._hosts:
                     s.budget = Resource(0, 0, 0)
 

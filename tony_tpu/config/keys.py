@@ -53,6 +53,11 @@ class Keys:
     METRICS_ENABLED = "metrics.enabled"
     PROFILER_ENABLED = "profiler.enabled"
     PROFILER_PORT = "profiler.port"
+    # persistent XLA compilation cache for fit() jobs: resubmits and elastic
+    # restarts skip compile — the dominant submit->first-step cost (the
+    # north-star latency metric; measured in docs/PERF.md)
+    TRAIN_JAX_CACHE = "train.jax_cache"
+    TRAIN_JAX_CACHE_DIR = "train.jax_cache_dir"  # default ~/.tony-tpu/jax_cache
     # cloud-tpu-diagnostics periodic stack traces (wedged-job debugging)
     DIAGNOSTICS_ENABLED = "diagnostics.enabled"
 
@@ -139,6 +144,9 @@ DEFAULTS: dict[str, object] = {
     Keys.METRICS_ENABLED: True,
     Keys.PROFILER_ENABLED: False,
     Keys.PROFILER_PORT: 9999,
+    Keys.TRAIN_JAX_CACHE: True,
+    Keys.TRAIN_JAX_CACHE_DIR: "",
+
     Keys.DIAGNOSTICS_ENABLED: False,
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,

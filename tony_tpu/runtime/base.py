@@ -12,6 +12,7 @@ path, BASELINE.json north star).
 from __future__ import annotations
 
 import json
+import os
 from dataclasses import dataclass
 
 from tony_tpu.config.config import TonyConfig
@@ -81,6 +82,14 @@ class Runtime:
                 "true" if config.get_bool(Keys.RESTART_RESUME_FROM_CHECKPOINT, True)
                 else "false"
             )
+        # Persistent XLA compilation cache: the single biggest submit->
+        # first-step lever (docs/PERF.md latency section) — resubmits and
+        # elastic gang restarts of the same job skip compile entirely.
+        # fit() applies it; default on, per-user shared dir.
+        if config.get_bool(Keys.TRAIN_JAX_CACHE, True):
+            env["TONY_JAX_CACHE_DIR"] = config.get_str(
+                Keys.TRAIN_JAX_CACHE_DIR, ""
+            ) or os.path.expanduser(os.path.join("~", ".tony-tpu", "jax_cache"))
         # One flag to get per-host traces (SURVEY.md section 5 "Tracing"):
         # the profiler server must live in the process doing the compute, so
         # the executor exports the intent and fit() starts it.

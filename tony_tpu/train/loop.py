@@ -81,6 +81,15 @@ def fit(cfg: FitConfig) -> dict:
 def _fit(cfg: FitConfig) -> dict:
     jax_tpu.initialize()  # no-op outside a tony-tpu job
     cfg.apply_job_env()
+    cache_dir = os.environ.get("TONY_JAX_CACHE_DIR", "")
+    if cache_dir:
+        # persistent XLA compilation cache (train.jax_cache, default on):
+        # a resubmitted or gang-restarted job loads its executables instead
+        # of recompiling — the dominant submit->first-step cost on TPU
+        # (docs/PERF.md latency section)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     if os.environ.get("TONY_PROFILER_PORT"):
         from tony_tpu.obs.profiler import start_server
 
@@ -146,7 +155,11 @@ def _fit(cfg: FitConfig) -> dict:
         inputs, targets = next(batches)
         state, metrics = step_fn(state, inputs, targets)
         window += 1
-        if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+        # the very first step always logs/pushes: it closes the AM-submit ->
+        # first-step loop (the north-star latency metric — the AM timestamps
+        # the resulting METRICS event) and gives users signal before a long
+        # log_every window elapses
+        if step == start_step or (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
             loss = float(metrics["loss"])  # device sync point
             timer = StepTimer(
                 flops_per_token=flops_per_token,
