@@ -310,14 +310,20 @@ def run_bench() -> dict:
     extra["flash_s32768"] = long_context_bench()
     try:
         # 4 experts (~1.2B total / ~700M active): the 8-expert preset's
-        # AdamW state alone exceeds the chip's 16GB
+        # AdamW state alone exceeds the chip's 16GB. Round-4 tuning
+        # (docs/PERF.md MoE section): gather/scatter dispatch (zero routing
+        # matmul FLOPs vs the one-hot einsums' ~2x-the-expert-FFN cost),
+        # capacity factor 1.0, batch 8 — 22.1% -> 37.1% MFU measured.
         moe_cfg = LlamaConfig.bench_moe(
-            n_experts=4, attention_impl="flash", remat_policy="save_attn_kernel"
+            n_experts=4, attention_impl="flash", remat_policy="save_attn_kernel",
+            moe_capacity_factor=1.0,
         )
-        moe = train_bench(moe_cfg, batch=4, seq=2048, steps=10, mu_dtype=jnp.bfloat16)
+        moe = train_bench(moe_cfg, batch=8, seq=2048, steps=10, mu_dtype=jnp.bfloat16)
         extra["moe_top2"] = {
             "n_params": moe_cfg.n_params,
             "n_active_params": moe_cfg.n_active_params,
+            "dispatch": moe_cfg.moe_dispatch,
+            "capacity_factor": 1.0,
             **moe,
         }
     except Exception as e:

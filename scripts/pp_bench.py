@@ -1,0 +1,69 @@
+"""GPipe vs 1F1B: wall-clock + compiled-FLOP comparison on the virtual
+8-CPU mesh (relative numbers; the schedules' compute graphs are identical
+on TPU, only the per-tick costs scale).
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      PYTHONPATH=/root/repo python scripts/pp_bench.py
+
+A vocab-sized head (32k) on a small trunk makes schedule waste visible:
+a schedule that runs the lm head on every stage every tick pays P x
+(M+2P-2)/M times the useful head FLOPs.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.models.llama import LlamaConfig
+from tony_tpu.parallel.mesh import MeshShape, build_mesh
+from tony_tpu.parallel.sharding import DEFAULT_RULES
+from tony_tpu.train.trainer import (
+    default_optimizer, make_train_state, make_train_step, pp_rules,
+)
+
+PP, M = 4, 8
+B, S = 16, 128
+
+
+def run(schedule: str) -> dict:
+    cfg = LlamaConfig(
+        vocab_size=32000, dim=256, n_layers=8, n_heads=8, n_kv_heads=8,
+        ffn_dim=688, max_seq_len=S, attention_impl="dot",
+        dtype=jnp.float32,  # CPU bench; bf16 trips an XLA-CPU promotion bug
+    )
+    mesh = build_mesh(MeshShape(pp=PP, fsdp=2))
+    opt = default_optimizer(warmup_steps=1, decay_steps=100)
+    rules = pp_rules(dict(DEFAULT_RULES))
+    state = make_train_state(jax.random.key(0), cfg, mesh, opt, rules)
+    step = make_train_step(
+        cfg, mesh, opt, rules, n_microbatches=M, pp_schedule=schedule
+    )
+    toks = jax.random.randint(jax.random.key(1), (B, S + 1), 0, cfg.vocab_size)
+    inp, tgt = toks[:, :-1], toks[:, 1:]
+
+    lowered = jax.jit(step).lower(state, inp, tgt)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    flops = float(cost.get("flops", -1)) if cost else -1.0
+
+    state2, m = step(state, inp, tgt)  # compile+run once
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    n = 5
+    for _ in range(n):
+        state2, m = step(state2, inp, tgt)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / n
+    return {
+        "schedule": schedule,
+        "step_ms": round(dt * 1e3, 1),
+        "compiled_gflops": round(flops / 1e9, 2),
+        "loss": round(float(m["loss"]), 4),
+    }
+
+
+if __name__ == "__main__":
+    for schedule in ("gpipe", "1f1b"):
+        print(json.dumps(run(schedule)), flush=True)

@@ -68,6 +68,7 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_dispatch: str = "gather"  # gather | einsum (see parallel.moe)
     moe_aux_coef: float = 0.01
 
     @property
@@ -359,6 +360,7 @@ def moe_ffn_block(x: jax.Array, lp: Params, cfg: LlamaConfig):
     mcfg = MoEConfig(
         dim=cfg.dim, ffn_dim=cfg.ffn_dim, n_experts=cfg.n_experts,
         top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+        dispatch=cfg.moe_dispatch,
     )
     return moe_block(
         {"router": lp["router"], "w1": lp["w1"], "w3": lp["w3"], "w2": lp["w2"]},

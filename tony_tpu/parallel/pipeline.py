@@ -273,6 +273,10 @@ def _1f1b_local(stage_params, head_params, xs, targets, *,
 
     last = n_stages - 1
 
+    def zeros_of(tree):
+        # a*0 (not zeros_like) keeps the varying-axes type on the zeros
+        return jax.tree.map(lambda a: a * 0, tree)
+
     def tick(t, carry):
         recv_f, recv_b, inbuf, loss, g_stage, g_head, dxs = carry
 
@@ -291,7 +295,16 @@ def _1f1b_local(stage_params, head_params, xs, targets, *,
             lax.dynamic_update_index_in_dim(inbuf, x_in, idx_f % buf_n, 0),
             inbuf,
         )
-        y = stage_fn(sp_local, x_in)
+        # warmup/drain ticks skip the stage compute entirely (lax.cond is
+        # per-device inside the manual region and both branches are
+        # collective-free): a stage whose slot is empty must not make its
+        # ppermute partners wait on garbage compute
+        y = lax.cond(
+            valid_f,
+            lambda a: stage_fn(sp_local, a),
+            lambda a: recv_f * 0,  # y-shaped varying zeros
+            x_in,
+        )
         send_f = lax.ppermute(y, axis_name, fwd_perm)
 
         # ---- backward slot: microbatch i_b leaves this stage ------------
@@ -299,26 +312,50 @@ def _1f1b_local(stage_params, head_params, xs, targets, *,
         valid_b = (i_b >= 0) & (i_b < M)
         idx_b = jnp.clip(i_b, 0, M - 1)
         x_saved = lax.dynamic_index_in_dim(inbuf, idx_b % buf_n, 0, keepdims=False)
-        y_b, pull = jax.vjp(lambda p, a: stage_fn(p, a), sp_local, x_saved)
-
-        # last stage: seed the cotangent from the per-microbatch loss head
         tgt = vary(lax.dynamic_index_in_dim(targets, idx_b, 0, keepdims=False))
-        loss_i, head_pull = jax.vjp(
-            lambda hp, a: head_fn(hp, a, tgt), hp_var, y_b
-        )
-        dhead_i, dy_head = head_pull(vary(jnp.asarray(1.0 / M, jnp.float32)))
-        mask_b = jnp.where(valid_b, 1.0, 0.0)
-        ct = jnp.where(my == last, dy_head.astype(y_b.dtype), recv_b)
+        one = vary(jnp.asarray(1.0, jnp.float32))  # varying scalar seed
 
-        dstage_i, dx_i = pull(ct)
-        g_stage = jax.tree.map(
-            lambda acc, gi: acc + gi * mask_b.astype(gi.dtype), g_stage, dstage_i
+        def bwd_slot(op):
+            x_saved_, recv_b_, tgt_, one_ = op
+            y_b, pull = jax.vjp(lambda p, a: stage_fn(p, a), sp_local, x_saved_)
+
+            # the loss head (final norm + vocab matmul + CE) runs ONLY on
+            # the last stage — running it everywhere and masking after the
+            # fact would add P-1 redundant vocab-sized fwd+bwd per tick
+            def head_slot(hy):
+                hp_, y_ = hy
+                loss_i, head_pull = jax.vjp(
+                    lambda hp, a: head_fn(hp, a, tgt_), hp_, y_
+                )
+                dhead_i, dy_head = head_pull(one_ / M)
+                return loss_i, dhead_i, dy_head
+
+            def head_skip(hy):
+                hp_, y_ = hy
+                return one_ * 0, zeros_of(hp_), y_ * 0
+
+            loss_i, dhead_i, dy_head = lax.cond(
+                my == last, head_slot, head_skip, (hp_var, y_b)
+            )
+            ct = jnp.where(my == last, dy_head.astype(y_b.dtype), recv_b_)
+            dstage_i, dx_i = pull(ct)
+            return loss_i, dhead_i, dstage_i, dx_i
+
+        def bwd_skip(op):
+            x_saved_, recv_b_, tgt_, one_ = op
+            return (
+                one_ * 0,
+                zeros_of(hp_var),
+                zeros_of(sp_local),
+                x_saved_ * 0,
+            )
+
+        loss_i, dhead_i, dstage_i, dx_i = lax.cond(
+            valid_b, bwd_slot, bwd_skip, (x_saved, recv_b, tgt, one)
         )
-        on_head = mask_b * jnp.where(my == last, 1.0, 0.0)
-        g_head = jax.tree.map(
-            lambda acc, gi: acc + gi * on_head.astype(gi.dtype), g_head, dhead_i
-        )
-        loss = loss + loss_i / M * on_head
+        g_stage = jax.tree.map(lambda acc, gi: acc + gi, g_stage, dstage_i)
+        g_head = jax.tree.map(lambda acc, gi: acc + gi, g_head, dhead_i)
+        loss = loss + loss_i / M
         # stage 0's input cotangent feeds the embedding backward
         dxs = jnp.where(
             valid_b & (my == 0),
