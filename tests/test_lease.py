@@ -399,3 +399,197 @@ def test_remote_placement_honors_store_packing(tmp_path):
     assert c_small.host == "h1"
     assert c_big.host == "h2"
     b.stop()
+
+
+# --- lease TTL / cross-host liveness -----------------------------------------
+
+
+def test_cross_host_ttl_expiry_frees_chips(tmp_path):
+    """A 'remote' owner (faked hostname) that stops renewing is reaped by
+    TTL expiry: a second job acquires after the TTL with NO operator
+    action — the cross-host crash case pid checks cannot cover."""
+    root = str(tmp_path / "rm")
+    remote = LeaseStore(root, owner_host="far-away-host", lease_ttl_s=0.5)
+    remote.register_hosts({"h1": res(4, 256, 8)})
+    remote.reserve_gang("remote-job", [GangAsk(res(4))], timeout_s=0)
+    s = LeaseStore(root)
+    with pytest.raises(InsufficientResources):  # not yet expired
+        s.reserve_gang("job-b", [GangAsk(res(4))], timeout_s=0)
+    # ...but once the TTL lapses, the waiter is granted automatically
+    s.reserve_gang("job-b", [GangAsk(res(4))], timeout_s=10)
+    assert "remote-job" not in s.summary()["apps"]
+
+
+def test_cross_host_renewal_keeps_lease_alive(tmp_path):
+    """An owner that RENEWS on schedule is never TTL-reaped, even from a
+    host where its pid cannot be checked."""
+    root = str(tmp_path / "rm")
+    remote = LeaseStore(root, owner_host="far-away-host", lease_ttl_s=0.6)
+    remote.register_hosts({"h1": res(4, 256, 8)})
+    remote.reserve_gang("remote-job", [GangAsk(res(4))], timeout_s=0)
+    s = LeaseStore(root)
+    deadline = time.time() + 1.8  # three TTLs
+    while time.time() < deadline:
+        remote.renew_app("remote-job")
+        time.sleep(0.05)
+    with pytest.raises(InsufficientResources, match="remote-job holds"):
+        s.reserve_gang("job-b", [GangAsk(res(4))], timeout_s=0)
+
+
+def test_local_liveness_beats_ttl(tmp_path):
+    """A same-host owner whose process is verifiably ALIVE keeps its leases
+    past the TTL without renewing — the pid check is authoritative, the
+    timer only covers owners it cannot see."""
+    s = store(tmp_path, lease_ttl_s=0.3)
+    s.register_hosts({"h1": res(4, 256, 8)})
+    s.reserve_gang("wedged-but-alive", [GangAsk(res(4))], timeout_s=0)
+    time.sleep(0.8)
+    with pytest.raises(InsufficientResources, match="wedged-but-alive"):
+        store(tmp_path).reserve_gang("job-b", [GangAsk(res(4))], timeout_s=0)
+
+
+def test_release_refuses_live_foreign_owner_force_overrides(tmp_path):
+    """release_app only drops entries the caller owns (or dead/expired
+    ones); a live sibling's leases need force_release_app — one job's
+    teardown can never yank another's chips."""
+    root = str(tmp_path / "rm")
+    remote = LeaseStore(root, owner_host="far-away-host")  # ttl 0: immortal
+    remote.register_hosts({"h1": res(4, 256, 8)})
+    remote.reserve_gang("their-job", [GangAsk(res(4))], timeout_s=0)
+    s = LeaseStore(root)
+    assert s.release_app("their-job") is False
+    assert "their-job" in s.summary()["apps"]
+    s.force_release_app("their-job")
+    assert "their-job" not in s.summary()["apps"]
+
+
+def test_reentry_transfers_ownership(tmp_path):
+    """An AM restart re-enters its reservation as a NEW process; ownership
+    must follow, or liveness/TTL tracking would keep watching the dead
+    predecessor and reap the successor's leases."""
+    root = str(tmp_path / "rm")
+    old = LeaseStore(root, owner_host="dead-am-host", lease_ttl_s=0)
+    old.register_hosts({"h1": res(4, 256, 8)})
+    p1 = old.reserve_gang("app", [GangAsk(res(4))], timeout_s=0)
+    new = LeaseStore(root, lease_ttl_s=0.5)
+    p2 = new.reserve_gang("app", [GangAsk(res(4))], timeout_s=0)
+    assert [h for _, h in p1] == [h for _, h in p2]
+    owner = new.summary()["apps"]["app"]["owner"]
+    assert owner.startswith(f"{os.uname().nodename}:")
+    assert new.release_app("app") is True  # the successor owns it now
+
+
+def test_local_budget_check_and_claim_are_atomic(tmp_path):
+    """Two concurrent allocate()s racing for the last budget slice: exactly
+    ONE may claim it; the loser must go through the store (which is full)
+    and reject — never consume private capacity past the leased budget."""
+    import sys as _sys
+
+    from tony_tpu.cluster.backend import ContainerRequest
+    from tony_tpu.cluster.local import LocalProcessBackend
+    from tony_tpu.utils.net import local_host
+
+    root = tmp_path
+    other = store(root)
+    b = LocalProcessBackend(
+        res(4, 4096, 16), lease_store=store(root), app_id="job-a"
+    )
+    b.start()  # registers this host: 4 chips
+    # a sibling job holds 2 of the 4 chips
+    other.reserve_gang(
+        "sibling", [GangAsk(res(2), host=local_host())], timeout_s=0
+    )
+    b.reserve_job([(res(2), "")], timeout_s=5)  # our budget: the other 2
+
+    def creq(i):
+        return ContainerRequest(
+            task_type="w", task_index=i, resource=res(2),
+            argv=[_sys.executable, "-c", "import time; time.sleep(15)"],
+            env={}, log_path=str(tmp_path / f"c{i}.log"),
+        )
+
+    results = [None, None]
+
+    def run(i):
+        try:
+            results[i] = b.allocate(creq(i))
+        except InsufficientResources as e:
+            results[i] = e
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+    ok = [r for r in results if not isinstance(r, Exception)]
+    rejected = [r for r in results if isinstance(r, InsufficientResources)]
+    assert len(ok) == 1 and len(rejected) == 1, results
+    b.stop()
+
+
+def test_ondemand_lease_slice_is_used_not_stranded(tmp_path):
+    """An on-demand lease's packing is recorded as a claimable slot: a
+    later matching container lands on the STORE-PACKED host, instead of
+    greedily re-packing onto leftover gang budget while the leased slice
+    strands on the packed host for the rest of the job."""
+    import sys as _sys
+
+    from tony_tpu.cluster.backend import ContainerRequest
+    from tony_tpu.cluster.remote import LocalTransport, RemoteBackend
+
+    b = RemoteBackend(
+        ["h1", "h2"],
+        transport=LocalTransport(),
+        host_capacity=res(4, 4096, 16),
+        lease_store=store(tmp_path),
+        app_id="job-a",
+    )
+    b.start()
+    b.reserve_job([(res(4), "")], timeout_s=5)  # gang: 4 chips -> h1
+
+    def creq(i, chips, cmd="pass"):
+        return ContainerRequest(
+            task_type="w", task_index=i, resource=res(chips),
+            argv=[_sys.executable, "-c", cmd],
+            env={}, log_path=str(tmp_path / f"c{i}.log"),
+        )
+
+    def wait_done(cid):
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            c = next(c for c in b.containers() if c.container_id == cid)
+            if c.state.name in ("COMPLETED", "RELEASED"):
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"{cid} never finished")
+
+    c0 = b.allocate(creq(0, 4, "import time; time.sleep(20)"))  # fills h1
+    assert c0.host == "h1"
+    c1 = b.allocate(creq(1, 2))  # no budget left -> on-demand, packed h2
+    assert c1.host == "h2"
+    wait_done(c1.container_id)
+    b.release(c0.container_id)
+    # the on-demand slice on h2 is still leased to this job; a matching
+    # ask must reuse it rather than strand it
+    c2 = b.allocate(creq(2, 2, "import time; time.sleep(5)"))
+    assert c2.host == "h2"
+    b.stop()
+
+
+def test_owner_fences_when_leases_revoked(tmp_path):
+    """The owner side of TTL safety: a job whose leases vanish from the
+    store (operator release / TTL reaping) learns it on its next renewal
+    and must fence — renew_leases() returns False for the AM to act on."""
+    from tony_tpu.cluster.local import LocalProcessBackend
+
+    b = LocalProcessBackend(
+        res(4, 4096, 16),
+        lease_store=store(tmp_path, lease_ttl_s=0.2),
+        app_id="job-a",
+    )
+    b.start()
+    b.reserve_job([(res(2), "")], timeout_s=5)
+    assert b.renew_leases() is True
+    store(tmp_path).force_release_app("job-a")
+    time.sleep(0.06)  # past the ttl/4 renew throttle
+    assert b.renew_leases() is False

@@ -94,6 +94,11 @@ class ApplicationMaster(ApplicationRpcServicer):
         # gloo rendezvous store for horovod jobs (the reference's AM-side
         # HorovodDriver, SURVEY.md section 3.4); started in run()
         self._rendezvous = None
+        # shared-RM lease keeper (started in run()): renews from its own
+        # thread so a hung store can never stall supervision
+        self._lease_keeper_stop = threading.Event()
+        self._lease_ok_t = time.monotonic()
+        self._lease_ttl = 0.0
 
     # --- executor launch ----------------------------------------------------
 
@@ -407,6 +412,7 @@ class ApplicationMaster(ApplicationRpcServicer):
             log.info("horovod gloo rendezvous serving on :%d", self._rendezvous.port)
         self.backend.set_completion_callback(self._on_container_completed)
         self.backend.start()
+        self._start_lease_keeper()
         # The AM's own footprint consumes inventory, like a YARN AM container.
         self.backend.reserve(
             Resource(
@@ -446,6 +452,37 @@ class ApplicationMaster(ApplicationRpcServicer):
             return 1
         return code
 
+    def _start_lease_keeper(self) -> None:
+        """Renew shared-RM lease TTLs from a DEDICATED thread, so that a
+        hung store (a hard-mounted shared FS that partitions blocks
+        forever in open()/flock, raising nothing) can never stall
+        container supervision. The keeper posts a ``fence`` notification
+        when renewal reports the leases lost; _supervise additionally
+        fences on renewal STALENESS at half the TTL — the keeper being
+        silently stuck is exactly the hang case the thread exists for,
+        and fencing at ttl/2 keeps the owner ahead of survivors reaping
+        at renewed_at + ttl on their own clocks."""
+        renew = getattr(self.backend, "renew_leases", None)
+        if renew is None:
+            return
+        self._lease_ttl = getattr(self.backend, "lease_ttl_s", lambda: 0.0)()
+        self._lease_ok_t = time.monotonic()
+
+        def keeper():
+            while not self._lease_keeper_stop.wait(self._heartbeat_interval_s):
+                try:
+                    ok = renew()
+                except Exception:
+                    log.exception("lease renewal raised (keeper carries on)")
+                    continue
+                if ok:
+                    self._lease_ok_t = time.monotonic()
+                else:
+                    self._notifications.put(("fence", None))
+                    return
+
+        threading.Thread(target=keeper, daemon=True, name="lease-keeper").start()
+
     def _supervise(self, deadline: float | None) -> None:
         while True:
             if self._killed.is_set():
@@ -476,6 +513,21 @@ class ApplicationMaster(ApplicationRpcServicer):
                 if task is not None and task.container_id == cid and task.state not in TERMINAL:
                     self._finish_task(job_name, index, code, pid_dead=authoritative)
             self._check_heartbeats()
+            # Fence when the lease keeper says our leases are GONE, or
+            # when it has been silently stuck (hung store) past the TTL:
+            # either way survivors may re-lease the chips this job is
+            # still running on — stop before that double-books.
+            if kind == "fence" or (
+                self._lease_ttl
+                and time.monotonic() - self._lease_ok_t > self._lease_ttl / 2
+            ):
+                self.session.diagnostics = (
+                    "shared-RM leases lost (TTL-reaped, operator release, "
+                    "or store unreachable past the TTL); stopping to avoid "
+                    "double-booking"
+                )
+                self.session.state = JobState.FAILED
+                return
             if self._apply_failure_policy():
                 return
             if self.session.job_done():
@@ -633,6 +685,7 @@ class ApplicationMaster(ApplicationRpcServicer):
             pass
 
     def _teardown(self) -> None:
+        self._lease_keeper_stop.set()
         self.scheduler.stop()
         self.backend.stop()
         if self._rendezvous is not None:

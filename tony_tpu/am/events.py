@@ -147,11 +147,18 @@ def _find_history_file(app_dir: str) -> str:
             d = cfg.get(key)
             if d:
                 candidates.insert(0, d)
+    private = os.path.join(app_dir, "events")
     for d in candidates:
         path = os.path.join(d, f"{app_id}.jhist.jsonl")
         if os.path.exists(path):
             return path
-        if os.path.isdir(d):  # unknown app-id naming: any single history file
+        # Unknown app-id naming: fall back to "the single history file" —
+        # but ONLY in the app-private default dir, where no other app can
+        # have written. In a SHARED configured history dir the lone file
+        # may belong to a different application entirely, and a latency
+        # breakdown silently computed from someone else's events is worse
+        # than the FileNotFoundError.
+        if d == private and os.path.isdir(d):
             files = [f for f in os.listdir(d) if f.endswith(".jhist.jsonl")]
             if len(files) == 1:
                 return os.path.join(d, files[0])

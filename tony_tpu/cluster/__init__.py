@@ -29,7 +29,10 @@ def make_backend(name: str, config=None, **kwargs) -> ClusterBackend:
         if rm_root and "lease_store" not in kwargs:
             from tony_tpu.cluster.lease import LeaseStore
 
-            kwargs["lease_store"] = LeaseStore(rm_root)
+            kwargs["lease_store"] = LeaseStore(
+                rm_root,
+                lease_ttl_s=config.get_float(Keys.CLUSTER_LEASE_TTL_S, 600.0),
+            )
         kwargs.setdefault(
             "rm_queue_timeout_s",
             config.get_float(Keys.AM_ALLOCATION_TIMEOUT_S, 300.0),

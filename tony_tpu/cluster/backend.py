@@ -166,6 +166,15 @@ class ClusterBackend(Protocol):
         """
         ...
 
+    def renew_leases(self) -> bool:
+        """Renew this job's shared-RM lease TTLs (no-op True without a
+        store). Called from the AM supervision loop on the heartbeat
+        cadence; the store throttles the actual locked write. Returns
+        False when the job's leases are GONE (TTL-reaped, operator
+        release, or store unreachable past the TTL) — the AM must then
+        fence: stop the job before its chips are double-booked."""
+        ...
+
     def total_capacity(self) -> Resource: ...
 
     def available(self) -> Resource: ...
@@ -195,6 +204,26 @@ class ClusterBackend(Protocol):
 
 class InsufficientResources(RuntimeError):
     """The ask does not fit in the currently-available inventory."""
+
+
+class _LeaseRenewalMixin:
+    """Shared-RM renewal surface for backends carrying a ``_store``
+    (LeaseStore or None), ``_app_id`` and ``_reserved_gangs``."""
+
+    def renew_leases(self) -> bool:
+        """Keep this job's store leases alive (TTL renewal); the AM calls
+        this on its heartbeat cadence, the store throttles internally.
+        False = this job's leases are gone (revoked or store unreachable
+        past the TTL): the caller must stop the job before its chips are
+        double-booked."""
+        if self._store is None or not self._reserved_gangs:
+            return True
+        return self._store.renew_app(self._app_id)
+
+    def lease_ttl_s(self) -> float:
+        """TTL of this job's shared-RM leases (0 = no store / no expiry);
+        the AM's lease keeper sizes its staleness fence from this."""
+        return self._store.lease_ttl_s if self._store is not None else 0.0
 
 
 class _InventoryMixin:

@@ -23,9 +23,17 @@ gang restarts relaunch into the same reservation) and are dropped by
 Crash safety: every app's entry records its owner (submit host, pid, pid
 start time from ``/proc``); any later locked operation by a surviving
 process on the same host reaps apps whose owner process is gone — the
-recovery YARN gets from AM liveness tracking. Cross-host stale owners
-cannot be pid-checked; ``force_release_app`` (surfaced as
-``tony rm-status --release APP``) is the operator override.
+recovery YARN gets from AM liveness tracking. Cross-host stale owners are
+covered by lease TTL (``cluster.lease_ttl_s``): entries carry their own
+``ttl_s`` + ``renewed_at``, owners renew on the AM heartbeat cadence
+(:meth:`LeaseStore.renew_app`, throttled) and while polling the grant
+queue, and any surviving process reaps entries whose TTL lapsed —
+UNLESS the owner is pid-verifiably alive on this host (local liveness
+beats the coarse timer). ``force_release_app`` (surfaced as
+``tony rm-status --release APP``) remains the immediate operator
+override; plain ``release_app`` only releases entries the caller owns
+(or dead/expired ones), so one job's teardown can never yank a live
+sibling's chips.
 """
 
 from __future__ import annotations
@@ -126,6 +134,7 @@ class LeaseStore:
         *,
         owner_host: str = "",
         poll_interval_s: float = 0.1,
+        lease_ttl_s: float = 0.0,
     ):
         self.root = os.path.abspath(os.path.expanduser(root))
         os.makedirs(self.root, exist_ok=True)
@@ -133,6 +142,22 @@ class LeaseStore:
         self._state_path = os.path.join(self.root, STATE_FILE)
         self._owner_host = owner_host or _this_host()
         self._poll_interval_s = poll_interval_s
+        # TTL THIS handle stamps onto entries it creates (0 = no expiry,
+        # manual/pid reaping only). Each entry is reaped against its OWN
+        # recorded ttl, so jobs with different configs coexist in one store.
+        self._lease_ttl_s = lease_ttl_s
+        self._last_renew = 0.0  # client-side renew throttle
+        # Fence clock: monotonic time of the last ``renewed_at`` the store
+        # actually RECORDED for this owner (commit, ticket enqueue, or an
+        # unthrottled touch) — NOT of arbitrary locked ops, which don't
+        # move the reapers' deadline. Survivors reap at renewed_at + ttl
+        # on THEIR clock; the owner fences at ack + ttl/2, leaving half a
+        # TTL of margin for wall-clock skew and scheduling delay.
+        self._last_renew_ack = time.monotonic()
+
+    @property
+    def lease_ttl_s(self) -> float:
+        return self._lease_ttl_s
 
     # --- locked state access ------------------------------------------------
 
@@ -170,30 +195,50 @@ class LeaseStore:
             finally:
                 fcntl.flock(lockf, fcntl.LOCK_UN)
 
-    def _reap_dead_owners(self, state: dict) -> None:
-        """Drop apps (leases) and queue tickets whose owner process is gone.
+    def _entry_dead(self, entry: Mapping) -> str:
+        """Why this app/ticket entry should be reaped ('' = keep).
 
-        Only owners on THIS host can be liveness-checked; remote owners are
-        left alone (explicit release or operator override). Tickets carry
-        their own owner: a job that dies while QUEUED has no app entry yet,
-        and its stale ticket would block the FIFO head forever."""
-        dead = [
-            app_id
+        Two independent detectors, mirroring YARN's AM-liveness tracking:
+
+        - pid check — authoritative, but only for owners on THIS host;
+        - TTL — an entry whose own ``ttl_s`` lapsed without renewal is
+          reaped REGARDLESS of host (the cross-host crash case pid checks
+          cannot cover), except when the owner is pid-verifiably alive
+          here: local liveness always beats the coarse timer, so a
+          same-host job wedged past its renew cadence is never yanked
+          while its process still runs.
+        """
+        local = entry.get("owner_host") == self._owner_host
+        alive = local and _pid_alive(
+            entry.get("owner_pid", 0), entry.get("owner_start", 0)
+        )
+        if local and not alive:
+            return "owner process gone"
+        ttl = entry.get("ttl_s", 0)
+        if ttl and not alive:
+            renewed = entry.get("renewed_at", 0)
+            if renewed and time.time() - renewed > ttl:
+                return f"lease TTL lapsed ({ttl:.0f}s without renewal)"
+        return ""
+
+    def _reap_dead_owners(self, state: dict) -> None:
+        """Drop apps (leases) and queue tickets whose owner is gone — by
+        pid on this host, by TTL anywhere (see :meth:`_entry_dead`).
+        Tickets carry their own owner: a job that dies while QUEUED has no
+        app entry yet, and its stale ticket would block the FIFO head
+        forever."""
+        dead = {
+            app_id: why
             for app_id, app in state["apps"].items()
-            if app["owner_host"] == self._owner_host
-            and not _pid_alive(app["owner_pid"], app.get("owner_start", 0))
-        ]
-        for app_id in dead:
-            log.warning("reaping leases of dead app %s", app_id)
+            if (why := self._entry_dead(app))
+        }
+        for app_id, why in dead.items():
+            log.warning("reaping leases of dead app %s (%s)", app_id, why)
             state["apps"].pop(app_id, None)
         state["queue"] = [
             t
             for t in state["queue"]
-            if t["app_id"] not in dead
-            and not (
-                t.get("owner_host") == self._owner_host
-                and not _pid_alive(t.get("owner_pid", 0), t.get("owner_start", 0))
-            )
+            if t["app_id"] not in dead and not self._entry_dead(t)
         ]
 
     # --- host registry ------------------------------------------------------
@@ -261,6 +306,18 @@ class LeaseStore:
                                     "reserved with different asks; release "
                                     "the app before reshaping the job"
                                 )
+                            # idempotent re-entry by a NEW process (AM
+                            # restart attempt): take over ownership, or
+                            # liveness/TTL tracking would keep following
+                            # the dead predecessor and reap the live
+                            # successor's leases out from under it
+                            app.update(
+                                owner_host=self._owner_host,
+                                owner_pid=os.getpid(),
+                                owner_start=_pid_start_time(os.getpid()),
+                                renewed_at=time.time(),
+                                ttl_s=self._lease_ttl_s,
+                            )
                             return [
                                 (a, h)
                                 for a, h in zip(asks, gang["hosts"])
@@ -287,8 +344,11 @@ class LeaseStore:
                             "owner_host": self._owner_host,
                             "owner_pid": os.getpid(),
                             "owner_start": _pid_start_time(os.getpid()),
+                            "renewed_at": time.time(),
+                            "ttl_s": self._lease_ttl_s,
                         }
                     )
+                    self._last_renew_ack = time.monotonic()
                 elif not any(t["seq"] == ticket_seq for t in state["queue"]):
                     # our ticket vanished without a grant: someone released
                     # this app externally (tony rm-status --release) — a
@@ -297,6 +357,11 @@ class LeaseStore:
                         f"gang for {app_id} was released externally while "
                         "queued (operator rm-status --release?)"
                     )
+                # each locked poll renews our ticket (and any leases this
+                # app already holds — e.g. the AM gang granted while the
+                # container gang queues), throttled to ttl/4 so read-only
+                # polls keep skipping the state-file rewrite
+                self._touch_entries(state, app_id, ticket_seq)
                 head = min(state["queue"], key=lambda t: t["seq"])
                 if head["seq"] == ticket_seq:
                     packing = self._try_pack(state, asks)
@@ -328,9 +393,8 @@ class LeaseStore:
                 if not (t["app_id"] == app_id and t["seq"] == seq)
             ]
 
-    @staticmethod
     def _commit(
-        state: dict, app_id: str, gang_id: str, want: list[dict],
+        self, state: dict, app_id: str, gang_id: str, want: list[dict],
         packing: list[str], owner_host: str,
     ) -> None:
         app = state["apps"].setdefault(
@@ -339,6 +403,8 @@ class LeaseStore:
                 "owner_host": owner_host,
                 "owner_pid": os.getpid(),
                 "owner_start": _pid_start_time(os.getpid()),
+                "renewed_at": time.time(),
+                "ttl_s": self._lease_ttl_s,
                 "gangs": [],
             },
         )
@@ -350,6 +416,98 @@ class LeaseStore:
                 "granted_at": time.time(),
             }
         )
+        self._last_renew_ack = time.monotonic()
+
+    def _touch_entries(
+        self, state: dict, app_id: str, ticket_seq: int | None = None
+    ) -> None:
+        """Refresh ``renewed_at`` on this app's entry and its queue
+        ticket(s) — the specific ticket when ``ticket_seq`` is given (the
+        grant-poll path), else every ticket of the app (the heartbeat
+        path). Throttled to a quarter of each entry's own TTL so renewal
+        traffic never dominates the store."""
+        now = time.time()
+        wrote = False
+        app = state["apps"].get(app_id)
+        if app is not None:
+            ttl = app.get("ttl_s", 0)
+            if ttl and now - app.get("renewed_at", 0) > ttl / 4:
+                app["renewed_at"] = now
+                wrote = True
+        for t in state["queue"]:
+            if t["seq"] == ticket_seq or (
+                ticket_seq is None and t["app_id"] == app_id
+            ):
+                ttl = t.get("ttl_s", 0)
+                if ttl and now - t.get("renewed_at", 0) > ttl / 4:
+                    t["renewed_at"] = now
+                    wrote = True
+        if wrote:
+            self._last_renew_ack = time.monotonic()
+
+    def renew_app(self, app_id: str) -> bool:
+        """Heartbeat-piggybacked lease renewal: the AM calls this on its
+        supervision cadence (1s-ish); the client-side throttle makes the
+        actual locked write at most once per ttl/4, and a no-op store
+        (ttl 0) never locks at all.
+
+        Returns False when the owner must FENCE — stop its containers
+        because it no longer holds its chips:
+
+        - the app's entries are GONE from a reachable store (TTL-reaped by
+          a survivor, or an operator ran ``rm-status --release``) — the
+          chips may already be re-leased to another job;
+        - the store has been unreachable for longer than the TTL (e.g. a
+          shared-FS partition), so survivors have by now reaped us and the
+          same double-booking is imminent. Transient hiccups inside the
+          TTL window just log and carry on: renewal has a 4x margin, a
+          skipped beat is harmless.
+        """
+        if not self._lease_ttl_s:
+            return True
+        now = time.monotonic()
+        if now - self._last_renew < self._lease_ttl_s / 4:
+            return True
+        try:
+            with self._locked() as state:
+                app = state["apps"].get(app_id)
+                if app is not None and not self._owned_by_caller(app):
+                    # a successor attempt took over this reservation
+                    # (re-entry ownership transfer): this process is the
+                    # SUPERSEDED owner and must not keep the entry alive —
+                    # or a dead successor's reservation would never expire
+                    log.error(
+                        "leases of %s now belong to %s:%s (successor "
+                        "attempt); this superseded owner must fence",
+                        app_id, app.get("owner_host"), app.get("owner_pid"),
+                    )
+                    return False
+                present = app is not None or any(
+                    t["app_id"] == app_id for t in state["queue"]
+                )
+                self._touch_entries(state, app_id)
+        except Exception as e:
+            # fence at HALF the TTL since the last recorded renewal:
+            # survivors reap at renewed_at + ttl on their own wall clock,
+            # so the margin absorbs clock skew and scheduling delay —
+            # fencing early is safe, fencing late double-books
+            if now - self._last_renew_ack > self._lease_ttl_s / 2:
+                log.error(
+                    "lease store unreachable since the last recorded "
+                    "renewal %.0fs ago (TTL %.0fs): fencing before "
+                    "survivors reap %s",
+                    now - self._last_renew_ack, self._lease_ttl_s, app_id,
+                )
+                return False
+            log.warning("lease renewal hiccup (TTL margin covers it): %s", e)
+            return True
+        self._last_renew = now
+        if not present:
+            log.error(
+                "leases of %s are GONE from the store (TTL-reaped or "
+                "operator-released); owner must fence", app_id,
+            )
+        return present
 
     # --- packing ------------------------------------------------------------
 
@@ -434,13 +592,46 @@ class LeaseStore:
 
     # --- release / inspection ----------------------------------------------
 
-    def release_app(self, app_id: str) -> None:
+    def _owned_by_caller(self, entry: Mapping) -> bool:
+        return (
+            entry.get("owner_host") == self._owner_host
+            and entry.get("owner_pid") == os.getpid()
+        )
+
+    def release_app(self, app_id: str) -> bool:
+        """Release an app's leases and tickets — but ONLY entries the
+        caller owns, or entries that are already dead/expired (see
+        :meth:`_entry_dead`). A live sibling's leases are refused with a
+        warning (returns False): one job's teardown must never yank
+        another's chips. Use :meth:`force_release_app` to override."""
+        with self._locked() as state:
+            app = state["apps"].get(app_id)
+            if app is not None and not (
+                self._owned_by_caller(app) or self._entry_dead(app)
+            ):
+                log.warning(
+                    "refusing to release %s: owned by live %s:%s (use "
+                    "force_release_app / tony rm-status --release)",
+                    app_id, app.get("owner_host"), app.get("owner_pid"),
+                )
+                return False
+            state["apps"].pop(app_id, None)
+            state["queue"] = [
+                t
+                for t in state["queue"]
+                if t["app_id"] != app_id
+                or not (self._owned_by_caller(t) or self._entry_dead(t))
+            ]
+        return True
+
+    def force_release_app(self, app_id: str) -> None:
+        """Operator override (``tony rm-status --release``): drop the app's
+        leases and tickets unconditionally, ignoring owner liveness — the
+        fast path for a wedged or unreachable cross-host owner that TTL
+        expiry has not yet caught."""
         with self._locked() as state:
             state["apps"].pop(app_id, None)
             state["queue"] = [t for t in state["queue"] if t["app_id"] != app_id]
-
-    # operator override for cross-host stale owners (cannot be pid-checked)
-    force_release_app = release_app
 
     def available(self) -> dict[str, Resource]:
         with self._locked() as state:

@@ -24,13 +24,14 @@ from tony_tpu.cluster.backend import (
     InsufficientResources,
     Resource,
     _InventoryMixin,
+    _LeaseRenewalMixin,
 )
 from tony_tpu.utils.net import local_host
 
 log = logging.getLogger(__name__)
 
 
-class LocalProcessBackend(_InventoryMixin):
+class LocalProcessBackend(_InventoryMixin, _LeaseRenewalMixin):
     """Subprocess containers against a fake, fixed inventory.
 
     With a shared :class:`~tony_tpu.cluster.lease.LeaseStore` attached
@@ -104,17 +105,30 @@ class LocalProcessBackend(_InventoryMixin):
             self._store_acquire("am", [r], self._rm_queue_timeout_s)
         super().reserve(r)
 
-    def _budget_guard(self, r: Resource, task_id: str) -> None:
-        """In shared-RM mode a container may only consume store-leased
-        budget; anything beyond it takes an on-demand single lease (an
-        immediate grant-or-raise, so an un-reserved direct allocate still
-        works when the cluster is idle but can never double-book)."""
-        if self._store is None:
-            return
-        with self._inv_lock:
-            short = not (self._in_use + r).fits_in(self._job_budget)
-        if short:
-            self._store_acquire(f"ondemand:{task_id}", [r], 0.0)
+    def _claim_within_budget(self, r: Resource, task_id: str) -> None:
+        """Atomically budget-check AND claim under ONE ``_inv_lock``
+        critical section (mirroring RemoteBackend's atomic budget-capped
+        placement). In shared-RM mode a container may only consume
+        store-leased budget; when short, an on-demand single lease is
+        taken OUTSIDE the lock (an immediate grant-or-raise, so an
+        un-reserved direct allocate still works when the cluster is idle
+        but can never double-book) and the check re-runs — a concurrent
+        allocate that consumed the widened budget in between just sends
+        us around the loop again with a fresh lease id, never past the
+        store's arbitration."""
+        attempt = 0
+        while True:
+            with self._inv_lock:
+                if self._store is None or (self._in_use + r).fits_in(self._job_budget):
+                    if not r.fits_in(self._capacity - self._in_use):
+                        raise InsufficientResources(
+                            f"ask {r} exceeds available {self._capacity - self._in_use}"
+                        )
+                    self._in_use = self._in_use + r
+                    return
+            gang_id = f"ondemand:{task_id}" + (f":{attempt}" if attempt else "")
+            self._store_acquire(gang_id, [r], 0.0)
+            attempt += 1
 
     def am_advertise_host(self) -> str:
         # Containers are subprocesses on this host; loopback is correct.
@@ -142,8 +156,7 @@ class LocalProcessBackend(_InventoryMixin):
                 f"LocalProcessBackend has no node labels (asked {request.node_label!r}); "
                 "use cluster.backend='remote' for labelled placement"
             )
-        self._budget_guard(request.resource, request.task_id)
-        self._claim(request.resource)
+        self._claim_within_budget(request.resource, request.task_id)
         try:
             with self._lock:
                 self._next_id += 1

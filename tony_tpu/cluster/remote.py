@@ -46,8 +46,9 @@ from tony_tpu.cluster.backend import (
     ContainerState,
     InsufficientResources,
     Resource,
+    _LeaseRenewalMixin,
 )
-from tony_tpu.utils.net import local_host
+from tony_tpu.utils.net import canonical_host, local_host
 
 log = logging.getLogger(__name__)
 
@@ -309,7 +310,7 @@ class _HostSlot:
         return cap - self.in_use
 
 
-class RemoteBackend:
+class RemoteBackend(_LeaseRenewalMixin):
     """Containers on a fixed inventory of remote hosts.
 
     Placement: first host whose remaining capacity fits the ask (and whose
@@ -385,17 +386,21 @@ class RemoteBackend:
     def start(self) -> None:
         self._stopped = False
         if self._store is not None:
-            names = [s.host for s in self._hosts]
+            # the store keys inventory by CANONICAL name, so "127.0.0.1"
+            # here and the hostname a LocalProcessBackend registers are one
+            # arbitrated machine, not two independently-leasable ones
+            names = [canonical_host(s.host) for s in self._hosts]
             if len(set(names)) != len(names):
                 log.warning(
-                    "cluster.hosts repeats a hostname; the shared RM store "
-                    "keys inventory by name, so duplicates collapse to ONE "
-                    "host's capacity (conservative, but less than you "
-                    "configured)"
+                    "cluster.hosts repeats a hostname (possibly two "
+                    "spellings of this machine); the shared RM store keys "
+                    "inventory by canonical name, so duplicates collapse "
+                    "to ONE host's capacity (conservative, but less than "
+                    "you configured)"
                 )
             self._store.register_hosts(
-                {s.host: s.capacity for s in self._hosts},
-                {s.host: s.label for s in self._hosts if s.label},
+                {canonical_host(s.host): s.capacity for s in self._hosts},
+                {canonical_host(s.host): s.label for s in self._hosts if s.label},
             )
 
     # --- shared-RM integration ---------------------------------------------
@@ -417,15 +422,21 @@ class RemoteBackend:
         )
         self._reserved_gangs.add(gang_id)
         with self._lock:
-            by_host = {s.host: s for s in self._hosts}
+            # the store speaks canonical names; map grants back to slots
+            by_host = {canonical_host(s.host): s for s in self._hosts}
             for ask, host in packing:
                 slot = by_host.get(host)
                 if slot is not None and slot.budget is not None:
                     slot.budget = slot.budget + ask.resource
-                if gang_id == "containers":
-                    # container asks become claimable placement slots
+                if gang_id != "am":
+                    # container AND on-demand asks become claimable
+                    # placement slots: allocate() must land on the host the
+                    # store packed, or the leased slice on the packed host
+                    # strands (capacity lost to every job) while the greedy
+                    # re-pack consumes some other host's leftover budget
                     self._gang_slots.append(
-                        [ask.resource, ask.node_label, host, ""]
+                        [ask.resource, ask.node_label,
+                         slot.host if slot is not None else host, ""]
                     )
 
     def reserve_job(self, asks, *, timeout_s: float | None = None, cancel=None) -> None:
@@ -433,7 +444,7 @@ class RemoteBackend:
             return
         from tony_tpu.cluster.lease import GangAsk
 
-        mine = tuple(s.host for s in self._hosts)
+        mine = tuple(canonical_host(s.host) for s in self._hosts)
         gang = [
             GangAsk(r, node_label=label, candidates=mine) for r, label in asks
         ]
@@ -478,11 +489,7 @@ class RemoteBackend:
         gang-allocation math never silently drifts."""
         with self._lock:
             am_slot = next(
-                (
-                    s
-                    for s in self._hosts
-                    if s.host in ("127.0.0.1", "localhost", local_host())
-                ),
+                (s for s in self._hosts if canonical_host(s.host) == local_host()),
                 None,
             )
         if am_slot is None:
@@ -495,7 +502,8 @@ class RemoteBackend:
             from tony_tpu.cluster.lease import GangAsk
 
             self._store_acquire(
-                "am", [GangAsk(r, host=am_slot.host)], self._rm_queue_timeout_s
+                "am", [GangAsk(r, host=canonical_host(am_slot.host))],
+                self._rm_queue_timeout_s,
             )
         with self._lock:
             if r.fits_in(am_slot.available()):
@@ -506,6 +514,18 @@ class RemoteBackend:
                     r, am_slot.host,
                 )
 
+    def _unclaimed_slot_reserve(self, host: str) -> Resource:
+        """Budget on ``host`` spoken for by UNCLAIMED gang slots — placement
+        must keep its hands off it, or a direct allocate of a different
+        shape could consume the budget backing a packed slot and the later
+        slot claim would push the host past its store lease. Caller holds
+        self._lock."""
+        total = Resource(0, 0, 0)
+        for gs in self._gang_slots:
+            if gs[3] == "" and gs[2] == host:
+                total = total + gs[0]
+        return total
+
     def _place(self, request: ContainerRequest) -> _HostSlot:
         if request.node_label and not any(
             s.label == request.node_label for s in self._hosts
@@ -515,7 +535,8 @@ class RemoteBackend:
         for s in self._hosts:
             if request.node_label and s.label != request.node_label:
                 continue
-            if request.resource.fits_in(s.available()):
+            free = s.available() - self._unclaimed_slot_reserve(s.host)
+            if request.resource.fits_in(free):
                 return s
         raise InsufficientResources(
             f"no host fits {request.resource} (label={request.node_label!r})"
@@ -524,13 +545,17 @@ class RemoteBackend:
     def _claim_gang_slot(self, request: ContainerRequest, cid: str) -> _HostSlot | None:
         """Claim a store-packed container slot matching (resource, label);
         returns its host's _HostSlot, or None when no gang slot matches.
-        Caller holds self._lock."""
+        The claim re-checks the host still has room (its own slot counts
+        as available again once excluded) — a defense in depth against
+        placement having eaten slot-backing budget. Caller holds
+        self._lock."""
         for gs in self._gang_slots:
             if gs[3] == "" and gs[0] == request.resource and gs[1] == request.node_label:
-                for s in self._hosts:
-                    if s.host == gs[2]:
-                        gs[3] = cid
-                        return s
+                s = next((h for h in self._hosts if h.host == gs[2]), None)
+                if s is not None and request.resource.fits_in(s.available()):
+                    gs[3] = cid
+                    return s
+                # host over-consumed or unknown: try another matching slot
         return None
 
     def allocate(self, request: ContainerRequest) -> Container:
@@ -552,22 +577,45 @@ class RemoteBackend:
             # single lease — immediate grant-or-raise, never double-booked
             from tony_tpu.cluster.lease import GangAsk
 
-            self._store_acquire(
-                f"ondemand:{request.task_id}",
-                [
-                    GangAsk(
-                        request.resource,
-                        node_label=request.node_label,
-                        candidates=tuple(s.host for s in self._hosts),
-                    )
-                ],
-                0.0,
-            )
-            with self._lock:
-                slot = self._place(request)
-                slot.in_use = slot.in_use + request.resource
-                self._next_id += 1
-                cid = f"container_{self._next_id:06d}"
+            # Acquire-then-claim loops: a concurrent allocate can steal the
+            # just-granted slot between the store grant and our locked
+            # claim, so the loser takes ANOTHER on-demand lease (fresh
+            # gang_id — the idempotency guard would no-op a repeat) and
+            # retries; termination is the store's grant-or-raise when
+            # capacity truly runs out. Mirrors LocalProcessBackend.
+            attempt = 0
+            while True:
+                gang_id = f"ondemand:{request.task_id}" + (
+                    f":{attempt}" if attempt else ""
+                )
+                self._store_acquire(
+                    gang_id,
+                    [
+                        GangAsk(
+                            request.resource,
+                            node_label=request.node_label,
+                            candidates=tuple(
+                                canonical_host(s.host) for s in self._hosts
+                            ),
+                        )
+                    ],
+                    0.0,
+                )
+                with self._lock:
+                    self._next_id += 1
+                    cid = f"container_{self._next_id:06d}"
+                    # land on the host the store packed (recorded as a
+                    # gang slot), never a greedy re-pack over stale budgets
+                    slot = self._claim_gang_slot(request, cid)
+                    if slot is None:
+                        try:
+                            slot = self._place(request)
+                        except InsufficientResources:
+                            slot = None
+                    if slot is not None:
+                        slot.in_use = slot.in_use + request.resource
+                        break
+                attempt += 1
         if request.log_path:
             os.makedirs(os.path.dirname(request.log_path) or ".", exist_ok=True)
             out: IO[bytes] = open(request.log_path, "ab")

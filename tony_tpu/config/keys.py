@@ -81,6 +81,13 @@ class Keys:
     # capacity from this file-locked store, so concurrent submits queue
     # FIFO instead of double-booking hosts/chips. Empty = per-job inventory.
     CLUSTER_RM_ROOT = "cluster.rm_root"
+    # lease TTL for the shared RM store: a job's leases expire this many
+    # seconds after their last renewal (the AM renews on its heartbeat
+    # cadence), so a submit host that dies on ANOTHER machine — where pid
+    # liveness cannot be checked — frees its chips automatically instead
+    # of stranding them until an operator runs `tony rm-status --release`.
+    # 0 disables expiry (manual/pid reaping only).
+    CLUSTER_LEASE_TTL_S = "cluster.lease_ttl_s"
 
     # --- portal/history ---
     HISTORY_INTERMEDIATE_DIR = "history.intermediate_dir"
@@ -155,6 +162,7 @@ DEFAULTS: dict[str, object] = {
     Keys.CLUSTER_LOCALIZE: False,
     Keys.CLUSTER_LOCALIZE_ROOT: "",
     Keys.CLUSTER_RM_ROOT: "",
+    Keys.CLUSTER_LEASE_TTL_S: 600,
     Keys.HISTORY_INTERMEDIATE_DIR: "",
     Keys.HISTORY_FINISHED_DIR: "",
     Keys.PORTAL_PORT: 8080,

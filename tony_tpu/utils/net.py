@@ -16,6 +16,23 @@ def local_host() -> str:
     return socket.gethostname()
 
 
+def canonical_host(name: str) -> str:
+    """One canonical key per physical machine for cross-job arbitration.
+
+    Backends spell the same machine differently (LocalProcessBackend
+    registers the hostname, a RemoteBackend config may say ``127.0.0.1`` or
+    ``localhost``); the shared LeaseStore keys inventory by name, so two
+    spellings of one machine would be two independently-leasable hosts —
+    silent double-booking. Loopback spellings and the local hostname all
+    collapse to the hostname; anything else (a genuinely remote address)
+    passes through untouched. Deliberately no DNS: resolution differing
+    between submit hosts would make the key non-deterministic.
+    """
+    if name in ("", "localhost", "127.0.0.1", "::1") or name == socket.gethostname():
+        return socket.gethostname()
+    return name
+
+
 def find_free_port(host: str = "") -> int:
     """Bind-probe an ephemeral port and release it.
 
