@@ -1,0 +1,139 @@
+"""AOT proof of the north-star config: Llama-2-7B sharded over a v4-32 slice.
+
+The north star (BASELINE.json ``north_star``, SURVEY.md section 6 config #4)
+is "multi-host JAX Llama-2-7B data-parallel on a v4-32 at >=45% MFU". No pod
+slice is attached to this rig, but JAX can prove the sharding STATICALLY:
+lower + compile the full production train step (bf16 params, AdamW with bf16
+mu, save_attn_kernel remat, flash attention) for the REAL 7B shapes over a
+32-device mesh of virtual CPU devices, then read the compiler's own
+per-device buffer assignment (``compiled.memory_analysis()``) against the
+v4 chip's 32GB HBM budget.
+
+Two shardings are analyzed:
+
+- ``fsdp32``       -- one slice, params/optimizer sharded 32-way (ZeRO-3).
+- ``dcn2xfsdp16``  -- two slices x 16 chips: ``build_multislice_mesh`` puts
+  the gradient-allreduce ``dp`` axis across DCN and keeps the
+  bandwidth-hungry fsdp all-gathers inside each slice's ICI.
+
+Caveat stated up front: the buffer assignment comes from the CPU backend, so
+exact padding/fusion differs from TPU; the point is that the ACTUAL 7B
+parameter, optimizer, gradient, and remat-activation buffers partition onto
+32 devices with headroom, not a bytes-exact TPU number.
+
+Run: ``python scripts/aot_7b_v4_32.py`` (forces 32 virtual CPU devices).
+Emits one JSON line per variant plus a summary verdict line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+N_DEVICES = 32
+V4_HBM_GB = 32.0  # HBM per v4 chip
+V4_PEAK_BF16_TFLOPS = 275.0  # per-chip peak, dense bf16
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        f"{_flags} --xla_force_host_platform_device_count={N_DEVICES}".strip()
+    )
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def analyze(name: str, mesh, cfg, batch: int, seq: int) -> dict:
+    from functools import partial
+
+    from tony_tpu.models.llama import init_params, train_flops_per_token
+    from tony_tpu.train.trainer import (
+        TrainState,
+        default_optimizer,
+        make_train_step,
+    )
+
+    opt = default_optimizer(mu_dtype=jnp.bfloat16)  # the bench configuration
+    step = make_train_step(cfg, mesh, opt)
+    params_shape = jax.eval_shape(partial(init_params, cfg=cfg), jax.random.key(0))
+    opt_shape = jax.eval_shape(opt.init, params_shape)
+    state = TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        params=params_shape,
+        opt_state=opt_shape,
+    )
+    tok = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    t0 = time.time()
+    compiled = step.lower(state, tok, tok).compile()
+    ma = compiled.memory_analysis()
+    # outputs alias the donated state; what's left is genuinely new bytes
+    per_device = (
+        ma.argument_size_in_bytes
+        + ma.temp_size_in_bytes
+        + ma.output_size_in_bytes
+        - ma.alias_size_in_bytes
+    )
+    tokens = batch * seq
+    flops_step = train_flops_per_token(cfg, seq) * tokens
+    gb = per_device / (1 << 30)
+    result = {
+        "variant": name,
+        "n_devices": N_DEVICES,
+        "per_device_gb": round(gb, 2),
+        "hbm_budget_gb": V4_HBM_GB,
+        "fits": gb <= V4_HBM_GB,
+        "headroom_gb": round(V4_HBM_GB - gb, 2),
+        "argument_gb": round(ma.argument_size_in_bytes / (1 << 30), 2),
+        "temp_gb": round(ma.temp_size_in_bytes / (1 << 30), 2),
+        "batch": batch,
+        "seq": seq,
+        "tokens_per_step": tokens,
+        "tflops_per_step_per_chip": round(flops_step / N_DEVICES / 1e12, 1),
+        "step_s_at_45pct_mfu": round(
+            flops_step / N_DEVICES / (0.45 * V4_PEAK_BF16_TFLOPS * 1e12), 2
+        ),
+        "compile_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps({"aot_7b": result}), flush=True)
+    return result
+
+
+def main() -> None:
+    from tony_tpu.models.llama import LlamaConfig
+    from tony_tpu.parallel.mesh import MeshShape, build_mesh, build_multislice_mesh
+    from tony_tpu.train.presets import north_star_7b_v4_32
+
+    cfg, shape, batch, seq = north_star_7b_v4_32()
+    assert shape.n_devices == N_DEVICES
+    devices = jax.devices()[:N_DEVICES]
+    results = [
+        analyze("fsdp32", build_mesh(shape, devices=devices), cfg, batch, seq),
+        analyze(
+            "dcn2xfsdp16",
+            build_multislice_mesh(
+                MeshShape(fsdp=N_DEVICES // 2), n_slices=2, devices=devices
+            ),
+            cfg,
+            batch,
+            seq,
+        ),
+    ]
+    n7b = LlamaConfig.llama2_7b().n_params
+    ok = all(r["fits"] for r in results)
+    print(
+        f"aot_7b verdict: llama2_7b ({n7b/1e9:.2f}B params) v4-32 "
+        + ", ".join(f"{r['variant']}: {r['per_device_gb']}GB" for r in results)
+        + f" per device <= {V4_HBM_GB:.0f}GB budget -> "
+        + ("FITS" if ok else "DOES NOT FIT")
+    )
+    if not ok:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

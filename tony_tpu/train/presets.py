@@ -1,0 +1,42 @@
+"""Named training presets: model + mesh + batch recipes pinned by artifacts.
+
+The reference ships runbook configs (BASELINE.md milestone configs 1-4);
+here the north-star recipe is code, so the bench, the AOT analysis
+(scripts/aot_7b_v4_32.py), and a production ``fit()`` all share one
+definition instead of three copies drifting apart.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tony_tpu.models.llama import LlamaConfig
+from tony_tpu.parallel.mesh import MeshShape
+
+
+def north_star_7b_v4_32() -> tuple[LlamaConfig, MeshShape, int, int]:
+    """BASELINE.json ``north_star`` / SURVEY.md section 6 config #4:
+    Llama-2-7B on a v4-32 slice (32 chips x 32GB HBM).
+
+    Returns ``(cfg, mesh_shape, global_batch, seq_len)``.
+
+    - ZeRO-3 layout: params + AdamW state sharded over ``fsdp=32``
+      (13.5GB bf16 params + 27GB bf16 mu / bf16 nu split 32 ways is
+      ~1.3GB resident per chip; per-layer all-gathers ride ICI).
+      For two-slice deployments use
+      ``build_multislice_mesh(MeshShape(fsdp=16), n_slices=2)`` — the
+      gradient-psum ``dp`` axis crosses DCN, fsdp stays intra-slice.
+    - batch 32 x seq 4096 = 131072 tokens/step (1 sequence per chip),
+      the bench remat policy (``save_attn_kernel``) and the pallas flash
+      kernel, exactly the single-chip-validated production path.
+    """
+    cfg = LlamaConfig.llama2_7b(
+        dtype=jnp.bfloat16,
+        remat=True,
+        remat_policy="save_attn_kernel",
+        attention_impl="flash",
+    )
+    return cfg, MeshShape(fsdp=32), 32, 4096
+
+
+__all__ = ["north_star_7b_v4_32"]
