@@ -1,0 +1,570 @@
+"""Chaos subsystem: injected faults against real jobs + invariant checking.
+
+Every scenario runs a genuine client -> AM -> executor job (the E2E
+posture of test_e2e.py) with a declarative ``chaos.*`` fault schedule
+armed inside the AM/executor processes, then verifies BOTH the expected
+recovery behavior and a zero-violation invariant report — the recovery
+contract as CI instead of prose (docs/CHAOS.md).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tony_tpu.chaos import (
+    active_injector,
+    chaos_hook,
+    install_from_config,
+    parse_faults,
+    uninstall,
+)
+from tony_tpu.chaos.invariants import check_invariants
+from tony_tpu.cli.client import TonyClient
+from tony_tpu.config.config import TonyConfig
+
+FAST = {
+    "task.heartbeat_interval_ms": 200,
+    "task.max_missed_heartbeats": 10,
+    "application.timeout_s": 90,
+}
+
+
+def chaos_submit(tmp_path, overrides, faults):
+    """Run one job under a fault schedule; returns (code, app_dir, report)."""
+    cfg = TonyConfig.load(
+        overrides={
+            **FAST,
+            "application.stage_dir": str(tmp_path),
+            "application.framework": "generic",
+            "chaos.enabled": True,
+            "chaos.faults": json.dumps(faults),
+            **overrides,
+        }
+    )
+    client = TonyClient(cfg)
+    code = client.run(quiet=True)
+    report = check_invariants(
+        [client.app_dir], rm_root=str(overrides.get("cluster.rm_root", ""))
+    )
+    return code, client.app_dir, report
+
+
+def read_status(app_dir):
+    with open(os.path.join(app_dir, "status.json")) as f:
+        return json.load(f)
+
+
+def events_of(app_dir):
+    from tony_tpu.am.events import read_history
+
+    ev_dir = os.path.join(app_dir, "events")
+    files = [f for f in os.listdir(ev_dir) if f.endswith(".jsonl")]
+    assert len(files) == 1
+    return read_history(os.path.join(ev_dir, files[0]))
+
+
+# --- the no-op contract ------------------------------------------------------
+
+
+def test_hooks_are_noops_when_chaos_absent():
+    """Acceptance criterion: with no chaos config, nothing arms and every
+    hook returns None — the entire subsystem is one global-load + compare
+    on the hot paths."""
+    assert active_injector() is None
+    assert chaos_hook("am.tick", attempt=0) is None
+    assert chaos_hook("lease.locked") is None
+    assert install_from_config(TonyConfig(), role="am") is False
+    assert active_injector() is None
+    # enabled but empty schedule: still inert
+    assert install_from_config(
+        TonyConfig({"chaos.enabled": True}), role="am"
+    ) is False
+    # schedule present but gate off: still inert
+    assert install_from_config(
+        TonyConfig({"chaos.faults": '[{"type": "kill_am", "at_count": 1}]'}),
+        role="am",
+    ) is False
+    assert active_injector() is None
+
+
+def test_fault_schedule_validation():
+    with pytest.raises(ValueError, match="unknown fault type"):
+        parse_faults('[{"type": "meteor_strike"}]')
+    with pytest.raises(ValueError, match="not valid JSON"):
+        parse_faults("{nope")
+    with pytest.raises(ValueError, match="unknown field"):
+        parse_faults('[{"type": "kill_am", "at_tick": 3}]')
+    with pytest.raises(ValueError, match="needs an explicit 'point'"):
+        parse_faults('[{"type": "delay_point", "delay_ms": 5}]')
+    specs = parse_faults(
+        '[{"type": "kill_container", "task": "worker:0", "at_count": 2}]'
+    )
+    assert specs[0].point == "executor.beat"
+    assert specs[0].role == "executor"
+    assert specs[0].attempt == 0  # kill faults default to attempt 0
+
+
+def test_role_and_window_filtering():
+    cfg = TonyConfig(
+        {
+            "chaos.enabled": True,
+            "chaos.faults": json.dumps(
+                [{"type": "drop_heartbeats", "task": "worker:0",
+                  "from_count": 2, "to_count": 3}]
+            ),
+        }
+    )
+    try:
+        assert install_from_config(cfg, role="executor") is True
+        hook = lambda **kw: chaos_hook("executor.beat", **kw)  # noqa: E731
+        assert hook(task="worker:0") is None          # count 1: before window
+        assert hook(task="worker:1") is None          # count 2: wrong task
+        assert hook(task="worker:0") is not None      # count 3: fires
+        assert hook(task="worker:0") is None          # count 4: past window
+    finally:
+        uninstall()
+
+
+# --- scenario 1: kill-container -> gang restart ------------------------------
+
+
+def test_chaos_kill_container_gang_restart(tmp_path):
+    """SIGKILL worker:0's container (executor + user process group) at its
+    2nd heartbeat; the gang restart policy relaunches the whole job and it
+    succeeds on attempt 1 — with a clean invariant report (monotonic
+    generations, terminal status)."""
+    code, app_dir, report = chaos_submit(
+        tmp_path,
+        {
+            "application.name": "chaos-killc",
+            "restart.policy": "gang",
+            "restart.max_worker_restarts": 2,
+            "job.worker.instances": 2,
+            "job.worker.command": 'python -c "import time; time.sleep(2)"',
+        },
+        [{"type": "kill_container", "task": "worker:0", "at_count": 2}],
+    )
+    assert code == 0
+    status = read_status(app_dir)
+    assert status["state"] == "SUCCEEDED"
+    # the kill really happened: every task went around twice
+    assert all(t["attempts"] == 2 for t in status["tasks"])
+    assert any(e["type"] == "GANG_RESTART" for e in events_of(app_dir))
+    assert report.ok, report.to_json()
+
+
+# --- scenario 2: kill-AM -> attempt recovery with lease re-ownership ---------
+
+
+def test_chaos_kill_am_attempt_recovery(tmp_path):
+    """SIGKILL the AM at supervision tick 3 (containers allocated and
+    journalled, leases held in the shared store). The client relaunches
+    attempt 1, which reaps the orphaned containers, takes over the store
+    reservation (the dead predecessor's entry is pid-reaped, the
+    re-reservation lands under the new owner), bumps the generation, and
+    the job succeeds. Store must be empty afterwards."""
+    rm_root = str(tmp_path / "rm")
+    code, app_dir, report = chaos_submit(
+        tmp_path,
+        {
+            "application.name": "chaos-killam",
+            "am.retry_count": 1,
+            "cluster.rm_root": rm_root,
+            "job.worker.instances": 2,
+            "job.worker.command": 'python -c "import time; time.sleep(4)"',
+        },
+        [{"type": "kill_am", "at_count": 3}],
+    )
+    assert code == 0
+    assert read_status(app_dir)["state"] == "SUCCEEDED"
+    with open(os.path.join(app_dir, "am.state.json")) as f:
+        snap = json.load(f)
+    assert snap["am_attempt"] == 1  # the kill consumed attempt 0
+    assert snap["generation"] >= 1
+    assert report.ok, report.to_json()
+    # all leases returned by the successor's teardown
+    from tony_tpu.cluster.lease import LeaseStore
+
+    summary = LeaseStore(rm_root).summary()
+    assert not summary["apps"] and not summary["queue"]
+
+
+# --- scenario 3: hang-store -> fence with client-visible FAILED --------------
+
+
+def test_chaos_hang_store_fences_and_client_sees_failed(tmp_path):
+    """The ADVICE round-5 medium bug, end-to-end: the lease store hangs
+    forever in open()/flock (hard-mount partition). The AM's lease keeper
+    goes silent, the staleness fence fires at ttl/2, and — this is the
+    fixed part — teardown SKIPS the lease release that used to wedge the
+    AM in the same flock, so status.json lands and the client sees FAILED
+    instead of hanging until its own timeout."""
+    rm_root = str(tmp_path / "rm")
+    t0 = time.monotonic()
+    code, app_dir, report = chaos_submit(
+        tmp_path,
+        {
+            "application.name": "chaos-hang",
+            "cluster.rm_root": rm_root,
+            "cluster.lease_ttl_s": 2,
+            "application.timeout_s": 60,
+            "job.worker.instances": 1,
+            "job.worker.command": 'python -c "import time; time.sleep(30)"',
+        },
+        # every store access blocks 120s once the job is running; only the
+        # AM is partitioned — the fence must come from staleness, not luck
+        [{"type": "hang_store", "after_s": 3, "duration_s": 120, "role": "am"}],
+    )
+    took = time.monotonic() - t0
+    assert code != 0
+    status = read_status(app_dir)  # exists at all == the wedge is fixed
+    assert status["state"] == "FAILED"
+    assert "leases lost" in status["diagnostics"]
+    # fenced at ~ttl/2 after the hang, not at the 30s worker sleep or the
+    # 60s app timeout (the old wedge ran the client into its timeout)
+    assert took < 25, f"fence path took {took:.1f}s — teardown blocked on the hung store?"
+    assert report.ok, report.to_json()
+
+
+# --- scenario 4: drop-heartbeats -> missed-heartbeat loss detection ----------
+
+
+def test_chaos_drop_heartbeats_task_lost(tmp_path):
+    """Suppress worker:0's executor->AM heartbeats from beat 3 on while
+    its user process keeps running: the AM's missed-heartbeat accounting
+    must mark the task LOST, fail the job, and release the container."""
+    code, app_dir, report = chaos_submit(
+        tmp_path,
+        {
+            "application.name": "chaos-hbdrop",
+            "task.heartbeat_interval_ms": 100,
+            "task.max_missed_heartbeats": 5,
+            "job.worker.instances": 1,
+            "job.worker.command": 'python -c "import time; time.sleep(30)"',
+        },
+        [{"type": "drop_heartbeats", "task": "worker:0", "from_count": 3}],
+    )
+    assert code != 0
+    status = read_status(app_dir)
+    assert status["state"] == "FAILED"
+    assert status["tasks"][0]["state"] == "LOST"
+    assert report.ok, report.to_json()
+
+
+# --- scenario 5: partition-host -> survivor reaping, no double-booking -------
+
+
+def test_chaos_partition_survivor_reaps_without_double_booking(tmp_path):
+    """Job A's AM is partitioned from the shared store (access raises for
+    that one owner); A fences and dies. Job B, sharing the store and
+    needing A's chips, reaps A's dead-owner entries and runs to success —
+    capacity transfers through reaping, never through double-booking
+    (checked over BOTH jobs' artifacts plus the store)."""
+    rm_root = str(tmp_path / "rm")
+    results = {}
+
+    def run_a():
+        results["a"] = chaos_submit(
+            tmp_path,
+            {
+                "application.name": "chaos-part-a",
+                "cluster.rm_root": rm_root,
+                "cluster.lease_ttl_s": 2,
+                "application.timeout_s": 60,
+                "job.worker.instances": 1,
+                "job.worker.tpu_chips": 64,  # the full local inventory
+                "job.worker.command": 'python -c "import time; time.sleep(30)"',
+            },
+            [{"type": "partition_host", "after_s": 3, "role": "am"}],
+        )
+
+    ta = threading.Thread(target=run_a)
+    ta.start()
+    time.sleep(4.0)  # A is running and holds every chip; partition begins
+    cfg_b = TonyConfig.load(
+        overrides={
+            **FAST,
+            "application.stage_dir": str(tmp_path),
+            "application.framework": "generic",
+            "application.name": "chaos-part-b",
+            "cluster.rm_root": rm_root,
+            "am.allocation_timeout_s": 60,
+            "job.worker.instances": 1,
+            "job.worker.tpu_chips": 64,
+            "job.worker.command": 'python -c "pass"',
+        }
+    )
+    client_b = TonyClient(cfg_b)
+    code_b = client_b.run(quiet=True)
+    ta.join(90)
+    code_a, dir_a, _ = results["a"]
+    assert code_a != 0 and read_status(dir_a)["state"] == "FAILED"
+    assert code_b == 0 and read_status(client_b.app_dir)["state"] == "SUCCEEDED"
+    report = check_invariants([dir_a, client_b.app_dir], rm_root=rm_root)
+    assert report.ok, report.to_json()
+    from tony_tpu.cluster.lease import LeaseStore
+
+    summary = LeaseStore(rm_root).summary()
+    assert not summary["apps"] and not summary["queue"]
+
+
+# --- scenario 6: delay-rpc -> control plane tolerates latency ----------------
+
+
+def test_chaos_delay_rpc_job_still_succeeds(tmp_path):
+    """Seeded latency on every served control-plane RPC: the job must
+    still assemble its gang and succeed — registration/heartbeat paths
+    tolerate a slow AM."""
+    code, app_dir, report = chaos_submit(
+        tmp_path,
+        {
+            "application.name": "chaos-rpcdelay",
+            "chaos.seed": 7,
+            "job.worker.instances": 2,
+            "job.worker.command": 'python -c "pass"',
+        },
+        [{"type": "delay_rpc", "delay_ms": 25, "jitter_ms": 25}],
+    )
+    assert code == 0
+    assert read_status(app_dir)["state"] == "SUCCEEDED"
+    assert report.ok, report.to_json()
+
+
+# --- the CLI / runner surface ------------------------------------------------
+
+
+def test_tony_chaos_cli_runs_and_reports(tmp_path, capsys):
+    """`tony chaos`: schedule via --faults, job runs under injection, the
+    invariant report prints as JSON, exit code reflects report + --expect."""
+    from tony_tpu.cli.main import main as cli_main
+
+    conf = tmp_path / "job.toml"
+    conf.write_text(
+        '[application]\nname = "chaos-cli"\nframework = "generic"\n'
+        f'stage_dir = "{tmp_path}"\ntimeout_s = 90\n'
+        "[task]\nheartbeat_interval_ms = 200\n"
+        "[job.worker]\ninstances = 1\n"
+        'command = "python -c \\"pass\\""\n'
+    )
+    rc = cli_main(
+        [
+            "chaos", "--conf", str(conf), "--quiet",
+            "--faults", '[{"type": "delay_rpc", "delay_ms": 10}]',
+            "--expect", "SUCCEEDED",
+        ]
+    )
+    out = capsys.readouterr().out
+    payload = json.loads(out[out.index("{"):])
+    assert rc == 0
+    assert payload["state"] == "SUCCEEDED"
+    assert payload["report"]["ok"] is True
+    # a malformed schedule fails before submitting anything
+    rc = cli_main(
+        ["chaos", "--conf", str(conf), "--faults", '[{"type": "nope"}]']
+    )
+    assert rc == 2
+
+
+# --- satellite regressions at the backend layer ------------------------------
+
+
+def test_fenced_backend_skips_lease_release(tmp_path):
+    """After fence_leases(), stop() must not touch the store: the entries
+    stay for pid/TTL reaping (releasing could block forever on the very
+    store whose unreachability caused the fence)."""
+    from tony_tpu.cluster.backend import Resource
+    from tony_tpu.cluster.lease import LeaseStore
+    from tony_tpu.cluster.local import LocalProcessBackend
+
+    store = LeaseStore(str(tmp_path / "rm"), lease_ttl_s=600)
+    b = LocalProcessBackend(
+        Resource(4096, 4, 16), lease_store=store, app_id="fenced-job"
+    )
+    b.start()
+    b.reserve_job([(Resource(64, 1, 4), "")], timeout_s=5)
+    b.fence_leases()
+    t0 = time.monotonic()
+    b.stop()
+    assert time.monotonic() - t0 < 5  # and it must not block either
+    assert "fenced-job" in LeaseStore(str(tmp_path / "rm")).summary()["apps"]
+
+
+def test_ondemand_losing_leases_released_not_stranded(tmp_path):
+    """ADVICE round 5 (remote.py:587 family): when the store's view of a
+    host is wider than the local inventory (another job registered it
+    first), on-demand grants can never be claimed locally. The acquire
+    loop must fail bounded AND hand every losing lease back — not strand
+    them for the job's lifetime."""
+    from tony_tpu.cluster.backend import (
+        ContainerRequest, InsufficientResources, Resource,
+    )
+    from tony_tpu.cluster.lease import LeaseStore
+    from tony_tpu.cluster.local import LocalProcessBackend
+    from tony_tpu.utils.net import local_host
+
+    root = str(tmp_path / "rm")
+    # a foreign job pinned this host's capacity WIDER than reality
+    LeaseStore(root, owner_host="first-registrar").register_hosts(
+        {local_host(): Resource(1 << 20, 256, 64)}
+    )
+    b = LocalProcessBackend(
+        Resource(4096, 4, 4),  # the real machine: only 4 chips
+        lease_store=LeaseStore(root),
+        app_id="overask-job",
+    )
+    b.start()
+    req = ContainerRequest(
+        task_type="w", task_index=0, resource=Resource(64, 1, 8),
+        argv=["true"], env={}, log_path="",
+    )
+    with pytest.raises(InsufficientResources):
+        b.allocate(req)  # store grants 8 chips; local capacity can't claim
+    # the losing on-demand lease went back to the store
+    summary = LeaseStore(root).summary()
+    assert "overask-job" not in summary["apps"], summary
+    b.stop()
+
+
+def test_remote_ondemand_retry_is_bounded_and_releases(tmp_path, monkeypatch):
+    """The RemoteBackend mirror: if grants never become claimable locally,
+    the loop gives up after ONDEMAND_MAX_ATTEMPTS store grants and leaves
+    zero leases behind."""
+    from tony_tpu.cluster.backend import (
+        ContainerRequest, InsufficientResources, Resource,
+    )
+    from tony_tpu.cluster.lease import LeaseStore
+    from tony_tpu.cluster.remote import LocalTransport, RemoteBackend
+
+    root = str(tmp_path / "rm")
+    b = RemoteBackend(
+        ["h1"],
+        transport=LocalTransport(),
+        host_capacity=Resource(4096, 4, 8),
+        lease_store=LeaseStore(root),
+        app_id="remote-overask",
+    )
+    b.start()
+    grants = []
+    orig_claim = RemoteBackend._claim_gang_slot
+
+    def never_claim(self, request, cid):
+        grants.append(cid)
+        return None  # simulate every local claim losing
+
+    monkeypatch.setattr(RemoteBackend, "_claim_gang_slot", never_claim)
+    monkeypatch.setattr(
+        RemoteBackend, "_place",
+        lambda self, request: (_ for _ in ()).throw(
+            InsufficientResources("forced")
+        ),
+    )
+    req = ContainerRequest(
+        task_type="w", task_index=0, resource=Resource(64, 1, 4),
+        argv=["true"], env={}, log_path="",
+    )
+    with pytest.raises(InsufficientResources, match="never claimable"):
+        b.allocate(req)
+    # one claim try before the loop + one per bounded on-demand attempt
+    assert len(grants) == RemoteBackend.ONDEMAND_MAX_ATTEMPTS + 1
+    monkeypatch.setattr(RemoteBackend, "_claim_gang_slot", orig_claim)
+    summary = LeaseStore(root).summary()
+    assert "remote-overask" not in summary["apps"], summary
+    b.stop()
+
+
+def test_lease_ttl_clamped_against_heartbeat(tmp_path, caplog):
+    """make_backend warns-and-clamps a TTL below 4x the heartbeat interval
+    (a config that would let a healthy cross-host owner self-fence)."""
+    import logging
+
+    from tony_tpu.cluster import make_backend
+
+    cfg = TonyConfig(
+        {
+            "cluster.rm_root": str(tmp_path / "rm"),
+            "cluster.lease_ttl_s": 0.5,
+            "task.heartbeat_interval_ms": 1000,
+        }
+    )
+    with caplog.at_level(logging.WARNING, logger="tony_tpu.cluster"):
+        b = make_backend("local", cfg, app_id="clamped")
+    assert b.lease_ttl_s() == 4.0
+    assert any("clamping TTL" in r.message for r in caplog.records)
+    # a sane TTL passes through untouched
+    cfg2 = TonyConfig(
+        {"cluster.rm_root": str(tmp_path / "rm2"), "cluster.lease_ttl_s": 600}
+    )
+    assert make_backend("local", cfg2, app_id="ok").lease_ttl_s() == 600.0
+
+
+def test_generation_monotonicity_follows_journal_order(tmp_path):
+    """AM-recovery and gang-restart generations interleave in emit order:
+    METADATA(recovered=1) then GANG_RESTART(2) is monotonic; the reverse
+    numbering is a violation."""
+
+    def job_with(events):
+        d = tmp_path / f"gen-{len(os.listdir(tmp_path)) if tmp_path.exists() else 0}"
+        d.mkdir()
+        (d / "status.json").write_text(
+            json.dumps({"state": "SUCCEEDED", "exit_code": 0, "tasks": []})
+        )
+        ev = d / "events"
+        ev.mkdir()
+        lines = [json.dumps(e) for e in events] + [
+            json.dumps({"type": "APPLICATION_FINISHED", "ts": 3, "state": "SUCCEEDED"})
+        ]
+        (ev / f"{d.name}.jhist.jsonl").write_text("\n".join(lines) + "\n")
+        return str(d)
+
+    ok_dir = job_with(
+        [
+            {"type": "METADATA", "ts": 1, "recovered_generation": 1},
+            {"type": "GANG_RESTART", "ts": 2, "generation": 2},
+        ]
+    )
+    assert check_invariants([ok_dir]).ok
+    bad_dir = job_with(
+        [
+            {"type": "GANG_RESTART", "ts": 1, "generation": 2},
+            {"type": "METADATA", "ts": 2, "recovered_generation": 1},
+        ]
+    )
+    report = check_invariants([bad_dir])
+    assert any(v.invariant == "generation-monotonic" for v in report.violations)
+
+
+def test_invariant_checker_flags_violations(tmp_path):
+    """The checker itself must fail loudly on broken artifacts — a checker
+    that cannot see violations proves nothing."""
+    # job dir with no status.json at all (the wedge symptom)
+    wedged = tmp_path / "wedged-app"
+    wedged.mkdir()
+    report = check_invariants([str(wedged)])
+    assert not report.ok
+    assert any(v.invariant == "terminal-status" for v in report.violations)
+    # a store entry with no reclaim path: live (our own) owner, terminal job
+    done = tmp_path / "done-app"
+    done.mkdir()
+    (done / "status.json").write_text(
+        json.dumps({"state": "SUCCEEDED", "exit_code": 0, "tasks": []})
+    )
+    ev = done / "events"
+    ev.mkdir()
+    (ev / "done-app.jhist.jsonl").write_text(
+        json.dumps({"type": "APPLICATION_FINISHED", "ts": 0, "state": "SUCCEEDED"})
+        + "\n"
+    )
+    from tony_tpu.cluster.backend import Resource
+    from tony_tpu.cluster.lease import GangAsk, LeaseStore
+
+    root = str(tmp_path / "rm")
+    s = LeaseStore(root, lease_ttl_s=0)  # no TTL: nothing will ever reap this
+    s.register_hosts({"h1": Resource(256, 4, 8)})
+    s.reserve_gang("done-app", [GangAsk(Resource(64, 1, 4))], timeout_s=0)
+    report = check_invariants([str(done)], rm_root=root)
+    assert any(v.invariant == "lease-no-strand" for v in report.violations), (
+        report.to_json()
+    )

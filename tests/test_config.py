@@ -136,6 +136,46 @@ def test_job_suffixes_match_taskspec_fields():
     assert fields == set(JOB_SUFFIXES)
 
 
+def test_minitoml_subset_matches_tomllib_semantics():
+    """The 3.10 fallback reader must agree with stdlib tomllib on the
+    subset it supports — same values in, same values (or an error) out."""
+    from tony_tpu.config import _minitoml as m
+
+    doc = (
+        "# header comment\n"
+        "[application]\n"
+        'name = "mnist"  # trailing comment\n'
+        "timeout_s = 300\n"
+        "ratio = 1.5\n"
+        "flag = true\n"
+        "[job.worker]\n"
+        "instances = 2\n"
+        "command = \"python -c \\\"print('hi # not a comment')\\\"\"\n"
+        "env = [\"A=1\", \"B=#2\",\n"
+        "       \"C=3\"]\n"
+        "tag = 'lit#eral'\n"
+    )
+    got = m.loads(doc)
+    assert got["application"] == {
+        "name": "mnist", "timeout_s": 300, "ratio": 1.5, "flag": True
+    }
+    w = got["job"]["worker"]
+    assert w["instances"] == 2
+    assert w["command"] == 'python -c "print(\'hi # not a comment\')"'
+    assert w["env"] == ["A=1", "B=#2", "C=3"]
+    assert w["tag"] == "lit#eral"
+    # anything beyond the subset fails loudly — never a half-parsed config
+    for bad in (
+        "[[jobs]]\nx = 1\n",              # arrays of tables
+        "x = {a = 1}\n",                  # inline tables
+        'x = """multi"""\n',              # multi-line strings
+        'x = "bad \\q escape"\n',         # invalid escape (tomllib rejects too)
+        "x = wat\n",                      # bare garbage value
+    ):
+        with pytest.raises(m.TOMLDecodeError):
+            m.loads(bad)
+
+
 def test_no_dead_config_keys():
     """Every advertised Keys.* constant must have a consumer outside
     keys.py — a config surface that silently ignores documented keys is

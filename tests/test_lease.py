@@ -463,20 +463,104 @@ def test_release_refuses_live_foreign_owner_force_overrides(tmp_path):
     assert "their-job" not in s.summary()["apps"]
 
 
-def test_reentry_transfers_ownership(tmp_path):
-    """An AM restart re-enters its reservation as a NEW process; ownership
-    must follow, or liveness/TTL tracking would keep watching the dead
-    predecessor and reap the successor's leases."""
+def test_reentry_after_dead_predecessor_takes_ownership(tmp_path):
+    """An AM restart re-enters its reservation as a NEW process once the
+    predecessor is provably gone (TTL lapsed here; pid-reaped when
+    same-host): the entry is reaped and the successor's re-reservation
+    lands on the same packing under its own ownership."""
     root = str(tmp_path / "rm")
-    old = LeaseStore(root, owner_host="dead-am-host", lease_ttl_s=0)
+    old = LeaseStore(root, owner_host="dead-am-host", lease_ttl_s=0.3)
     old.register_hosts({"h1": res(4, 256, 8)})
     p1 = old.reserve_gang("app", [GangAsk(res(4))], timeout_s=0)
+    time.sleep(0.4)  # predecessor's TTL lapses without renewal
     new = LeaseStore(root, lease_ttl_s=0.5)
     p2 = new.reserve_gang("app", [GangAsk(res(4))], timeout_s=0)
     assert [h for _, h in p1] == [h for _, h in p2]
     owner = new.summary()["apps"]["app"]["owner"]
     assert owner.startswith(f"{os.uname().nodename}:")
     assert new.release_app("app") is True  # the successor owns it now
+
+
+def test_reentry_refuses_takeover_from_live_incumbent(tmp_path):
+    """ADVICE round 5: a duplicate submit with the same app_id/gang/asks
+    must NOT steal a live incumbent's reservation — that double-books the
+    chips until the incumbent's next renew fences it. The re-entry is
+    refused with a pointer at force_release_app, and the incumbent keeps
+    its leases."""
+    from tony_tpu.cluster.lease import LeaseStoreError
+
+    root = str(tmp_path / "rm")
+    incumbent = LeaseStore(root, owner_host="other-submit-host", lease_ttl_s=600)
+    incumbent.register_hosts({"h1": res(4, 256, 8)})
+    incumbent.reserve_gang("app", [GangAsk(res(4))], timeout_s=0)
+    dup = LeaseStore(root, lease_ttl_s=600)
+    with pytest.raises(LeaseStoreError, match="refusing ownership takeover"):
+        dup.reserve_gang("app", [GangAsk(res(4))], timeout_s=0)
+    owner = LeaseStore(root).summary()["apps"]["app"]["owner"]
+    assert owner.startswith("other-submit-host:")
+    # the operator override still clears the way for a legitimate restart
+    dup.force_release_app("app")
+    dup.reserve_gang("app", [GangAsk(res(4))], timeout_s=0)
+    assert dup.release_app("app") is True
+
+
+def test_refused_takeover_dequeues_its_own_ticket(tmp_path):
+    """A duplicate submit that QUEUED behind its incumbent must drop its
+    ticket when the takeover is refused — like every other rejection
+    path, or the dead ticket would block the FIFO head for everyone."""
+    from tony_tpu.cluster.lease import LeaseStoreError
+
+    root = str(tmp_path / "rm")
+    blocker = LeaseStore(root)
+    blocker.register_hosts({"h1": res(8, 256, 8)})
+    blocker.reserve_gang("blocker", [GangAsk(res(8))], timeout_s=0)
+    results = {}
+
+    def run(name, host):
+        s = LeaseStore(root, owner_host=host, poll_interval_s=0.05)
+        try:
+            s.reserve_gang("dup", [GangAsk(res(8))], timeout_s=30)
+            results[name] = "granted"
+        except LeaseStoreError:
+            results[name] = "refused"
+
+    t1 = threading.Thread(target=run, args=("incumbent", "host-b"))
+    t1.start()
+    # the incumbent's ticket must be queued before the duplicate enqueues
+    deadline = time.time() + 10
+    while time.time() < deadline and not LeaseStore(root).summary()["queue"]:
+        time.sleep(0.05)
+    t2 = threading.Thread(target=run, args=("duplicate", "host-c"))
+    t2.start()
+    time.sleep(0.3)  # both queued, FIFO order incumbent -> duplicate
+    blocker.release_app("blocker")
+    t1.join(15)
+    t2.join(15)
+    assert results == {"incumbent": "granted", "duplicate": "refused"}
+    summary = LeaseStore(root).summary()
+    assert summary["queue"] == []  # the refused duplicate left no ticket
+    assert summary["apps"]["dup"]["owner"].startswith("host-b:")
+
+
+def test_release_gang_returns_single_reservation(tmp_path):
+    """release_gang hands back ONE gang (the losing-on-demand rollback
+    path) while the app's other reservations stay live; releasing the
+    last gang drops the app entry so ownership never outlives holdings."""
+    s = store(tmp_path)
+    s.register_hosts({"h1": res(8, 256, 8)})
+    s.reserve_gang("app", [GangAsk(res(4))], gang_id="containers", timeout_s=0)
+    s.reserve_gang("app", [GangAsk(res(2))], gang_id="ondemand:w:0", timeout_s=0)
+    assert s.release_gang("app", "ondemand:w:0") is True
+    leases = s.summary()["apps"]["app"]["leases"]
+    assert len(leases) == 1 and leases[0]["tpu_chips"] == 4
+    assert s.available()["h1"].tpu_chips == 4
+    assert s.release_gang("app", "containers") is True
+    assert "app" not in s.summary()["apps"]
+    # a foreign live owner's gang is refused (same rule as release_app)
+    far = LeaseStore(str(tmp_path / "rm"), owner_host="far-away")
+    far.reserve_gang("theirs", [GangAsk(res(2))], timeout_s=0)
+    assert s.release_gang("theirs", "containers") is False
+    assert "theirs" in s.summary()["apps"]
 
 
 def test_local_budget_check_and_claim_are_atomic(tmp_path):

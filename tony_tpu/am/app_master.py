@@ -26,6 +26,7 @@ import time
 from typing import Any
 
 from tony_tpu.am.events import EventType, EventWriter
+from tony_tpu.chaos import chaos_hook
 from tony_tpu.am.scheduler import SchedulerHooks, TaskScheduler
 from tony_tpu.am.session import JobState, Session, TaskState, TERMINAL
 from tony_tpu.cluster import make_backend
@@ -466,6 +467,16 @@ class ApplicationMaster(ApplicationRpcServicer):
         if renew is None:
             return
         self._lease_ttl = getattr(self.backend, "lease_ttl_s", lambda: 0.0)()
+        if 0 < self._lease_ttl < 4 * self._heartbeat_interval_s:
+            # make_backend clamps config-built stores; this catches
+            # directly-constructed backends handed a mismatched pair
+            log.warning(
+                "lease TTL %.1fs is below 4x the heartbeat interval "
+                "(%.2fs): renewal cadence is max(heartbeat, ttl/4), so a "
+                "healthy cross-host owner can lapse between renewals and "
+                "self-fence",
+                self._lease_ttl, self._heartbeat_interval_s,
+            )
         self._lease_ok_t = time.monotonic()
 
         def keeper():
@@ -485,6 +496,9 @@ class ApplicationMaster(ApplicationRpcServicer):
 
     def _supervise(self, deadline: float | None) -> None:
         while True:
+            # chaos seam: kill_am fires here (mid-run AM attempt death);
+            # the per-point count makes "at supervision tick N" exact
+            chaos_hook("am.tick", attempt=self.am_attempt)
             if self._killed.is_set():
                 self.session.state = JobState.KILLED
                 return
@@ -526,6 +540,13 @@ class ApplicationMaster(ApplicationRpcServicer):
                     "or store unreachable past the TTL); stopping to avoid "
                     "double-booking"
                 )
+                # the store is gone or unreachable: teardown must not call
+                # release_app against it — the release would block in the
+                # same flock the keeper is already hung in and the client
+                # would never see this FAILED status (ADVICE round 5)
+                fence = getattr(self.backend, "fence_leases", None)
+                if fence is not None:
+                    fence()
                 self.session.state = JobState.FAILED
                 return
             if self._apply_failure_policy():
@@ -736,6 +757,11 @@ def main() -> None:
     config = TonyConfig.from_json(
         open(os.path.join(app_dir, "config.json")).read()
     )
+    # arm fault injection for THIS process only when the job asks for it
+    # (chaos.enabled + a schedule); inert otherwise
+    from tony_tpu.chaos import install_from_config
+
+    install_from_config(config, role="am")
     am = ApplicationMaster(
         config, app_id, app_dir,
         am_attempt=int(os.environ.get("TONY_AM_ATTEMPT", "0")),

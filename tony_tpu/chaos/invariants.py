@@ -1,0 +1,270 @@
+"""Post-mortem recovery-invariant checker.
+
+After a (chaos or plain) job finishes, this module re-reads the durable
+artifacts the orchestration layer is contractually obliged to leave
+behind and verifies the recovery contract — machine-checkable versions of
+the guarantees the module docstrings promise in prose:
+
+``terminal-status``
+    Every checked job reached a client-visible terminal status:
+    ``status.json`` exists, its state is SUCCEEDED/FAILED/KILLED, and the
+    exit code is consistent (0 iff SUCCEEDED). This is the invariant the
+    fence-path wedge (ADVICE round 5, medium) violated: an AM that hangs
+    in teardown never writes the file.
+
+``events-complete``
+    The .jhist journal carries an APPLICATION_FINISHED whose state
+    matches ``status.json`` — history consumers (portal, latency
+    tooling) must never see a job that just stops mid-journal.
+
+``generation-monotonic``
+    Restart generations recorded in the journal (GANG_RESTART
+    ``generation``, AM-recovery METADATA ``recovered_generation``)
+    strictly increase: a generation reuse would let ghost executors from
+    a previous incarnation poison the new gang's barrier.
+
+``lease-no-strand``
+    No lease-store entry of a terminal job outlives it past reclaim:
+    every surviving entry must be reapable — owner provably dead on this
+    host (pid reaping catches it on the next locked op) or TTL-expiring
+    (survivors reap at ``renewed_at + ttl``). A live owner still holding
+    leases for a finished job, or a TTL-less entry with an unreachable
+    owner, is a stranded chip.
+
+``lease-no-double-book``
+    Per host, the sum of all apps' leased resources never exceeds the
+    host's registered capacity — two owners can never hold the same slot.
+
+The checker reads the store's ``state.json`` RAW (no LeaseStore handle):
+going through the store would run its reapers and destroy the evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from tony_tpu.am.events import EventType, read_history
+from tony_tpu.cluster.lease import STATE_FILE, _pid_alive, _this_host
+
+TERMINAL_STATES = ("SUCCEEDED", "FAILED", "KILLED")
+
+
+@dataclass(frozen=True)
+class Violation:
+    invariant: str
+    subject: str  # app id / host / store entry the violation is about
+    detail: str
+
+    def to_dict(self) -> dict[str, str]:
+        return {"invariant": self.invariant, "subject": self.subject, "detail": self.detail}
+
+
+@dataclass
+class InvariantReport:
+    checked_apps: list[str] = field(default_factory=list)
+    violations: list[Violation] = field(default_factory=list)
+    notes: list[str] = field(default_factory=list)  # non-fatal observations
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "checked_apps": list(self.checked_apps),
+            "violations": [v.to_dict() for v in self.violations],
+            "notes": list(self.notes),
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+
+def _read_status(app_dir: str) -> dict | None:
+    path = os.path.join(app_dir, "status.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _read_events(app_dir: str) -> list[dict]:
+    ev_dir = os.path.join(app_dir, "events")
+    if not os.path.isdir(ev_dir):
+        return []
+    events: list[dict] = []
+    for name in sorted(os.listdir(ev_dir)):
+        if name.endswith(".jhist.jsonl"):
+            events.extend(read_history(os.path.join(ev_dir, name)))
+    return events
+
+
+def _check_job(app_dir: str, report: InvariantReport) -> tuple[str, str]:
+    """Check one finished job's artifacts; returns (app_id, state)."""
+    app_id = os.path.basename(os.path.abspath(app_dir).rstrip("/"))
+    report.checked_apps.append(app_id)
+    status = _read_status(app_dir)
+    if status is None:
+        report.violations.append(
+            Violation(
+                "terminal-status", app_id,
+                "no status.json: the client can never learn this job's outcome "
+                "(AM wedged or died before _write_status)",
+            )
+        )
+        return app_id, ""
+    state = str(status.get("state", ""))
+    code = status.get("exit_code")
+    if state not in TERMINAL_STATES:
+        report.violations.append(
+            Violation("terminal-status", app_id, f"non-terminal final state {state!r}")
+        )
+    if state == "SUCCEEDED" and code != 0:
+        report.violations.append(
+            Violation("terminal-status", app_id, f"SUCCEEDED with exit_code {code!r}")
+        )
+    if state in ("FAILED", "KILLED") and code == 0:
+        report.violations.append(
+            Violation("terminal-status", app_id, f"{state} with exit_code 0")
+        )
+
+    events = _read_events(app_dir)
+    finished = [e for e in events if e.get("type") == EventType.APPLICATION_FINISHED]
+    if not finished:
+        report.violations.append(
+            Violation("events-complete", app_id, "no APPLICATION_FINISHED in the .jhist journal")
+        )
+    elif state and finished[-1].get("state") != state:
+        report.violations.append(
+            Violation(
+                "events-complete", app_id,
+                f"journal final state {finished[-1].get('state')!r} != status.json {state!r}",
+            )
+        )
+
+    # journal order is emit order; restarts of either kind must never
+    # reuse or rewind a generation — collect in ONE pass so a gang restart
+    # after an AM recovery compares against it, not past it
+    generations = []
+    for e in events:
+        if e.get("type") == EventType.GANG_RESTART and "generation" in e:
+            generations.append(e["generation"])
+        elif e.get("type") == EventType.METADATA and "recovered_generation" in e:
+            generations.append(e["recovered_generation"])
+    for prev, cur in zip(generations, generations[1:]):
+        if cur <= prev:
+            report.violations.append(
+                Violation(
+                    "generation-monotonic", app_id,
+                    f"restart generation went {prev} -> {cur} (sequence {generations})",
+                )
+            )
+            break
+    return app_id, state
+
+
+def _check_store(rm_root: str, terminal_apps: dict[str, str], report: InvariantReport) -> None:
+    """Raw-read the lease store and apply the strand/double-book rules."""
+    state_path = os.path.join(os.path.abspath(os.path.expanduser(rm_root)), STATE_FILE)
+    if not os.path.exists(state_path):
+        report.notes.append(f"lease store {rm_root}: no state file (never used)")
+        return
+    with open(state_path) as f:
+        try:
+            store = json.load(f)
+        except json.JSONDecodeError as e:
+            report.violations.append(
+                Violation("lease-no-strand", rm_root, f"unreadable store state: {e}")
+            )
+            return
+    here = _this_host()
+    now = time.time()
+
+    def reclaimable(entry: dict) -> str:
+        """Why this entry will be reclaimed without an operator ('' = never)."""
+        if entry.get("owner_host") == here and not _pid_alive(
+            int(entry.get("owner_pid", 0)), int(entry.get("owner_start", 0))
+        ):
+            return "owner dead on this host (pid reap on next store access)"
+        ttl = float(entry.get("ttl_s", 0) or 0)
+        if ttl > 0:
+            lapse = now - float(entry.get("renewed_at", 0) or 0)
+            if entry.get("owner_host") == here:
+                # owner alive here: local liveness blocks TTL reaping
+                return ""
+            return f"TTL reaping due in {max(ttl - lapse, 0.0):.0f}s"
+        return ""
+
+    for app_id, app in store.get("apps", {}).items():
+        if app_id not in terminal_apps:
+            continue  # another tenant's live job: not ours to judge
+        why = reclaimable(app)
+        if why:
+            report.notes.append(f"store entry {app_id}: reclaimable ({why})")
+        else:
+            report.violations.append(
+                Violation(
+                    "lease-no-strand", app_id,
+                    f"leases outlive terminal job ({terminal_apps[app_id]}) with no "
+                    f"reclaim path: owner {app.get('owner_host')}:{app.get('owner_pid')} "
+                    f"ttl_s={app.get('ttl_s')}",
+                )
+            )
+    for t in store.get("queue", []):
+        app_id = t.get("app_id", "")
+        if app_id in terminal_apps and not reclaimable(t):
+            report.violations.append(
+                Violation(
+                    "lease-no-strand", app_id,
+                    f"queue ticket seq={t.get('seq')} outlives terminal job with no reclaim path",
+                )
+            )
+
+    hosts = store.get("hosts", {})
+    leased: dict[str, list[int]] = {h: [0, 0, 0] for h in hosts}
+    for app_id, app in store.get("apps", {}).items():
+        for gang in app.get("gangs", []):
+            for ask, host in zip(gang.get("asks", []), gang.get("hosts", [])):
+                if host in leased:
+                    leased[host][0] += int(ask.get("memory_mb", 0))
+                    leased[host][1] += int(ask.get("cpus", 0))
+                    leased[host][2] += int(ask.get("tpu_chips", 0))
+    for host, (mem, cpus, chips) in leased.items():
+        cap = hosts[host]
+        if (
+            mem > int(cap.get("memory_mb", 0))
+            or cpus > int(cap.get("cpus", 0))
+            or chips > int(cap.get("tpu_chips", 0))
+        ):
+            report.violations.append(
+                Violation(
+                    "lease-no-double-book", host,
+                    f"leased (mem={mem} cpus={cpus} chips={chips}) exceeds registered "
+                    f"capacity (mem={cap.get('memory_mb')} cpus={cap.get('cpus')} "
+                    f"chips={cap.get('tpu_chips')})",
+                )
+            )
+
+
+def check_invariants(app_dirs: list[str] | str, rm_root: str = "") -> InvariantReport:
+    """Verify the recovery contract over finished application dir(s) and,
+    when ``rm_root`` is given, the shared lease store they ran against."""
+    if isinstance(app_dirs, str):
+        app_dirs = [app_dirs]
+    report = InvariantReport()
+    terminal_apps: dict[str, str] = {}
+    for d in app_dirs:
+        app_id, state = _check_job(d, report)
+        if state in TERMINAL_STATES:
+            terminal_apps[app_id] = state
+    if rm_root:
+        _check_store(rm_root, terminal_apps, report)
+    return report
+
+
+__all__ = ["InvariantReport", "Violation", "check_invariants", "TERMINAL_STATES"]

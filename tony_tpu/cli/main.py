@@ -167,6 +167,40 @@ def cmd_history(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_chaos(args: argparse.Namespace) -> int:
+    """Run one real job under a seeded fault schedule and print the
+    recovery-invariant report (docs/CHAOS.md). Exit 0 iff the report is
+    clean AND the final state matches --expect (when given) — the JOB is
+    allowed to fail; that is often the point of the schedule."""
+    from tony_tpu.chaos import parse_faults
+    from tony_tpu.chaos.runner import run_chaos_job
+    from tony_tpu.config.keys import Keys
+
+    config = TonyConfig.load(args.conf, overrides=args.define, read_env=True)
+    faults = args.faults
+    if faults.startswith("@"):
+        with open(faults[1:]) as f:
+            faults = f.read()
+    if faults:
+        config.set(Keys.CHAOS_FAULTS, faults)
+    try:  # malformed/empty schedule: fail before submitting anything
+        if not parse_faults(config.get(Keys.CHAOS_FAULTS)):
+            raise ValueError("no faults scheduled (chaos.faults is empty)")
+    except ValueError as e:
+        print(f"bad fault schedule: {e}", file=sys.stderr)
+        return 2
+    result = run_chaos_job(config, src_dir=args.src_dir or "", quiet=args.quiet)
+    print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+    ok = result.report.ok
+    if args.expect and result.state != args.expect:
+        print(
+            f"expected final state {args.expect} but job ended {result.state or 'UNKNOWN'}",
+            file=sys.stderr,
+        )
+        ok = False
+    return 0 if ok else 1
+
+
 def cmd_rm_status(args: argparse.Namespace) -> int:
     """Inspect (or clean) the shared ResourceManager lease store — the
     `yarn top` analogue for the cross-job arbitration substrate."""
@@ -246,6 +280,27 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("history", help="list applications")
     s.add_argument("--dir", help="apps root (default ~/.tony-tpu/apps)")
     s.set_defaults(fn=cmd_history)
+
+    s = sub.add_parser(
+        "chaos",
+        help="run a job under a fault schedule and report recovery invariants",
+    )
+    s.add_argument("--conf", help="TOML config for the job under test")
+    s.add_argument("--src-dir", help="source dir staged into containers")
+    s.add_argument(
+        "-D", "--define", action="append", default=[], metavar="KEY=VALUE",
+        help="config override (repeatable)",
+    )
+    s.add_argument(
+        "--faults", default="",
+        help="JSON fault schedule (or @file.json); overrides chaos.faults",
+    )
+    s.add_argument(
+        "--expect", default="", choices=["", "SUCCEEDED", "FAILED", "KILLED"],
+        help="require this final job state in addition to a clean report",
+    )
+    s.add_argument("--quiet", action="store_true")
+    s.set_defaults(fn=cmd_chaos)
 
     s = sub.add_parser(
         "rm-status",

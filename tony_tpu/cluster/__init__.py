@@ -1,5 +1,7 @@
 """Resource substrate: ClusterBackend protocol + implementations."""
 
+import logging
+
 from tony_tpu.cluster.backend import (
     ClusterBackend,
     Container,
@@ -12,6 +14,8 @@ from tony_tpu.cluster.lease import GangAsk, LeaseStore
 from tony_tpu.cluster.local import LocalProcessBackend
 from tony_tpu.cluster.remote import LocalTransport, RemoteBackend, SshTransport
 from tony_tpu.cluster.tpu_vm import TpuVmBackend
+
+log = logging.getLogger(__name__)
 
 
 def make_backend(name: str, config=None, **kwargs) -> ClusterBackend:
@@ -29,10 +33,23 @@ def make_backend(name: str, config=None, **kwargs) -> ClusterBackend:
         if rm_root and "lease_store" not in kwargs:
             from tony_tpu.cluster.lease import LeaseStore
 
-            kwargs["lease_store"] = LeaseStore(
-                rm_root,
-                lease_ttl_s=config.get_float(Keys.CLUSTER_LEASE_TTL_S, 600.0),
-            )
+            ttl = config.get_float(Keys.CLUSTER_LEASE_TTL_S, 600.0)
+            # renewal rides the AM heartbeat cadence (throttled to ttl/4):
+            # a TTL at or below the heartbeat interval lets a HEALTHY
+            # cross-host owner's entries lapse between renewals, so
+            # survivors reap a live job and it self-fences. 4x keeps the
+            # renewal margin the design assumes.
+            hb_s = config.get_int(Keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000.0
+            floor = 4.0 * hb_s
+            if 0 < ttl < floor:
+                log.warning(
+                    "cluster.lease_ttl_s=%.1f is below 4x the heartbeat "
+                    "interval (%.1fs): a healthy owner could be TTL-reaped "
+                    "between renewals and self-fence; clamping TTL to %.1fs",
+                    ttl, hb_s, floor,
+                )
+                ttl = floor
+            kwargs["lease_store"] = LeaseStore(rm_root, lease_ttl_s=ttl)
         kwargs.setdefault(
             "rm_queue_timeout_s",
             config.get_float(Keys.AM_ALLOCATION_TIMEOUT_S, 300.0),

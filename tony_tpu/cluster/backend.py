@@ -22,9 +22,12 @@ There is no YARN here, so the substrate itself is a pluggable
 from __future__ import annotations
 
 import enum
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Mapping, Protocol, Sequence
+
+log = logging.getLogger(__name__)
 
 
 class ContainerState(enum.Enum):
@@ -209,6 +212,61 @@ class InsufficientResources(RuntimeError):
 class _LeaseRenewalMixin:
     """Shared-RM renewal surface for backends carrying a ``_store``
     (LeaseStore or None), ``_app_id`` and ``_reserved_gangs``."""
+
+    # set by fence_leases(): this job's leases are lost/unreachable and
+    # teardown must NOT touch the store again
+    _lease_fenced = False
+
+    # lost on-demand acquire-then-claim races are bounded: past this many
+    # store grants that never become locally claimable, allocate() gives
+    # up instead of spinning (each losing lease is returned to the store)
+    ONDEMAND_MAX_ATTEMPTS = 5
+
+    def fence_leases(self) -> None:
+        """The AM calls this when it fences (leases gone, or store
+        unreachable past the TTL): teardown then skips ``release_app``
+        entirely. Releasing would at best be redundant (the entries are
+        already gone or TTL/pid reaping reclaims them) and at worst wedge
+        the AM forever in a flock against the very store whose hang caused
+        the fence — the ADVICE round-5 failure where the client never sees
+        FAILED."""
+        self._lease_fenced = True
+
+    def _release_store_leases(self, timeout_s: float = 10.0) -> None:
+        """Hand every lease back at job end — bounded. The release runs in
+        a daemon thread with a join timeout so a store that hangs in
+        open()/flock can never stall teardown past ``timeout_s``: the AM
+        must always reach ``_write_status``, and an unreleased entry is
+        reclaimed by pid/TTL reaping anyway."""
+        if self._store is None:
+            return
+        if self._lease_fenced:
+            log.warning(
+                "fenced: skipping lease release of %s (reaping reclaims the "
+                "entries; releasing could block on the unreachable store)",
+                self._app_id,
+            )
+            return
+        done = threading.Event()
+
+        def _rel() -> None:
+            try:
+                self._store.release_app(self._app_id)
+            except Exception:
+                log.warning(
+                    "lease release of %s failed (pid/TTL reaping will "
+                    "reclaim)", self._app_id, exc_info=True,
+                )
+            finally:
+                done.set()
+
+        threading.Thread(target=_rel, daemon=True, name="lease-release").start()
+        if not done.wait(timeout_s):
+            log.error(
+                "lease release of %s still blocked after %.0fs (hung store?); "
+                "abandoning it to pid/TTL reaping so teardown can finish",
+                self._app_id, timeout_s,
+            )
 
     def renew_leases(self) -> bool:
         """Keep this job's store leases alive (TTL renewal); the AM calls

@@ -47,6 +47,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Callable, Iterator, Mapping, Sequence
 
+from tony_tpu.chaos import chaos_hook
 from tony_tpu.cluster.backend import InsufficientResources, Resource
 
 log = logging.getLogger(__name__)
@@ -169,6 +170,13 @@ class LeaseStore:
         mutate (dequeue their ticket) and then raise, and that dequeue must
         land or the dead ticket would block the queue head forever.
         """
+        # chaos seam: hang_store blocks here (a hard-mounted shared FS that
+        # stalls in open/flock), partition_host raises OSError here (store
+        # unreachable from this owner only). BEFORE the flock, so an
+        # injected outage in one process never locks the store for
+        # survivors — exactly the real failure's shape. No-op unless this
+        # process armed an injector.
+        chaos_hook("lease.locked", root=self.root)
         with open(self._lock_path, "a+") as lockf:
             fcntl.flock(lockf, fcntl.LOCK_EX)
             try:
@@ -310,7 +318,49 @@ class LeaseStore:
                             # restart attempt): take over ownership, or
                             # liveness/TTL tracking would keep following
                             # the dead predecessor and reap the live
-                            # successor's leases out from under it
+                            # successor's leases out from under it.
+                            # But ONLY from an owner that is dead or our
+                            # own: a live incumbent (duplicate submit of
+                            # the same app_id, or a cross-host restart
+                            # before TTL expiry) must not be silently
+                            # dispossessed — that launches a second gang
+                            # onto chips the incumbent keeps using until
+                            # its next renew fences it (~ttl/4 + heartbeat
+                            # of double-booking). Dead same-host owners
+                            # never reach here (_reap_dead_owners already
+                            # dropped them), so refusing live non-owned
+                            # incumbents loses only the
+                            # cross-host-restart-within-TTL case, which
+                            # force_release_app covers by design.
+                            if not (
+                                self._owned_by_caller(app)
+                                or self._entry_dead(app)
+                            ):
+                                # like every rejection path: drop our own
+                                # queued ticket (we may have enqueued while
+                                # the incumbent's identical gang was still
+                                # queued ahead) or the dead ticket would
+                                # block the FIFO head for everyone
+                                self._dequeue(state, app_id, ticket_seq)
+                                log.warning(
+                                    "refusing reservation takeover of %s "
+                                    "gang %r from live owner %s:%s "
+                                    "(duplicate submit? cross-host restart "
+                                    "before TTL expiry needs "
+                                    "force_release_app / tony rm-status "
+                                    "--release)",
+                                    app_id, gang_id,
+                                    app.get("owner_host"),
+                                    app.get("owner_pid"),
+                                )
+                                raise LeaseStoreError(
+                                    f"gang {gang_id!r} of {app_id} is held "
+                                    "by live owner "
+                                    f"{app.get('owner_host')}:"
+                                    f"{app.get('owner_pid')}; refusing "
+                                    "ownership takeover (use "
+                                    "force_release_app to override)"
+                                )
                             app.update(
                                 owner_host=self._owner_host,
                                 owner_pid=os.getpid(),
@@ -622,6 +672,30 @@ class LeaseStore:
                 if t["app_id"] != app_id
                 or not (self._owned_by_caller(t) or self._entry_dead(t))
             ]
+        return True
+
+    def release_gang(self, app_id: str, gang_id: str) -> bool:
+        """Release ONE gang of an app while its other reservations stay
+        live — the rollback path for a losing on-demand lease (the backend
+        acquired it but a concurrent allocate consumed the matching local
+        budget, or the store's view of a host exceeds the local one).
+        Without this, every lost race strands a lease for the job's whole
+        lifetime. Same ownership rules as :meth:`release_app`."""
+        with self._locked() as state:
+            app = state["apps"].get(app_id)
+            if app is None:
+                return True
+            if not (self._owned_by_caller(app) or self._entry_dead(app)):
+                log.warning(
+                    "refusing to release gang %r of %s: owned by live %s:%s",
+                    gang_id, app_id, app.get("owner_host"), app.get("owner_pid"),
+                )
+                return False
+            app["gangs"] = [g for g in app["gangs"] if g["gang_id"] != gang_id]
+            if not app["gangs"]:
+                # a gang-less app entry would pin ownership forever while
+                # holding nothing; queue tickets carry their own owner
+                state["apps"].pop(app_id, None)
         return True
 
     def force_release_app(self, app_id: str) -> None:

@@ -16,6 +16,7 @@ import subprocess
 import threading
 from typing import IO
 
+from tony_tpu.chaos import chaos_hook
 from tony_tpu.cluster.backend import (
     CompletionCallback,
     Container,
@@ -105,6 +106,25 @@ class LocalProcessBackend(_InventoryMixin, _LeaseRenewalMixin):
             self._store_acquire("am", [r], self._rm_queue_timeout_s)
         super().reserve(r)
 
+    def _release_ondemand(self, gang_id: str, r: Resource) -> None:
+        """Roll back a losing on-demand lease: withdraw its budget and hand
+        it back to the store — but only when the widening is provably
+        unconsumed. If a concurrent allocate already claimed against it,
+        the lease now backs that claim and releasing it would let the
+        store re-grant chips this job is still using."""
+        with self._inv_lock:
+            if not r.fits_in(self._job_budget - self._in_use):
+                return
+            self._job_budget = self._job_budget - r
+        self._reserved_gangs.discard(gang_id)
+        try:
+            self._store.release_gang(self._app_id, gang_id)
+        except Exception:
+            log.warning(
+                "could not return losing on-demand lease %s (TTL/pid "
+                "reaping will reclaim)", gang_id, exc_info=True,
+            )
+
     def _claim_within_budget(self, r: Resource, task_id: str) -> None:
         """Atomically budget-check AND claim under ONE ``_inv_lock``
         critical section (mirroring RemoteBackend's atomic budget-capped
@@ -115,20 +135,37 @@ class LocalProcessBackend(_InventoryMixin, _LeaseRenewalMixin):
         but can never double-book) and the check re-runs — a concurrent
         allocate that consumed the widened budget in between just sends
         us around the loop again with a fresh lease id, never past the
-        store's arbitration."""
+        store's arbitration. The loop is bounded and every raise path
+        returns the leases it acquired but never claimed: a store whose
+        view of this host exceeds the local capacity (another job
+        registered it first, wider) would otherwise grant leases forever
+        that strand for the job's lifetime."""
         attempt = 0
-        while True:
-            with self._inv_lock:
-                if self._store is None or (self._in_use + r).fits_in(self._job_budget):
-                    if not r.fits_in(self._capacity - self._in_use):
-                        raise InsufficientResources(
-                            f"ask {r} exceeds available {self._capacity - self._in_use}"
-                        )
-                    self._in_use = self._in_use + r
-                    return
-            gang_id = f"ondemand:{task_id}" + (f":{attempt}" if attempt else "")
-            self._store_acquire(gang_id, [r], 0.0)
-            attempt += 1
+        acquired: list[str] = []
+        try:
+            while True:
+                with self._inv_lock:
+                    if self._store is None or (self._in_use + r).fits_in(self._job_budget):
+                        if not r.fits_in(self._capacity - self._in_use):
+                            raise InsufficientResources(
+                                f"ask {r} exceeds available {self._capacity - self._in_use}"
+                            )
+                        self._in_use = self._in_use + r
+                        return
+                if attempt >= self.ONDEMAND_MAX_ATTEMPTS:
+                    raise InsufficientResources(
+                        f"on-demand budget for {task_id} was store-granted "
+                        f"{attempt} times but never claimable locally "
+                        "(concurrent allocates keep winning the budget race)"
+                    )
+                gang_id = f"ondemand:{task_id}" + (f":{attempt}" if attempt else "")
+                self._store_acquire(gang_id, [r], 0.0)
+                acquired.append(gang_id)
+                attempt += 1
+        except BaseException:
+            for gid in acquired:
+                self._release_ondemand(gid, r)
+            raise
 
     def am_advertise_host(self) -> str:
         # Containers are subprocesses on this host; loopback is correct.
@@ -147,6 +184,7 @@ class LocalProcessBackend(_InventoryMixin, _LeaseRenewalMixin):
     def allocate(self, request: ContainerRequest) -> Container:
         if self._stopped:
             raise InsufficientResources("backend stopped")
+        chaos_hook("backend.allocate", task=request.task_id, backend="local")
         if request.node_label:
             # One host, no labels: honour the ask by refusing it rather than
             # silently placing anywhere (RemoteBackend implements labels).
@@ -254,8 +292,10 @@ class LocalProcessBackend(_InventoryMixin, _LeaseRenewalMixin):
         for cid, t in list(self._waiters.items()):
             t.join(timeout=10)
         if self._store is not None:
-            # the job is over: hand every lease back to the shared RM
-            self._store.release_app(self._app_id)
+            # the job is over: hand every lease back to the shared RM —
+            # bounded (and skipped entirely after a fence), so a hung
+            # store can never wedge teardown before _write_status
+            self._release_store_leases()
             self._reserved_gangs.clear()
             with self._inv_lock:
                 self._job_budget = Resource(0, 0, 0)

@@ -39,6 +39,7 @@ import time
 from dataclasses import dataclass, field
 from typing import IO, Mapping, Protocol, Sequence
 
+from tony_tpu.chaos import chaos_hook
 from tony_tpu.cluster.backend import (
     CompletionCallback,
     Container,
@@ -340,8 +341,10 @@ class RemoteBackend(_LeaseRenewalMixin):
         self._rm_queue_timeout_s = rm_queue_timeout_s
         self._reserved_gangs: set[str] = set()
         # store-packed container slots: [resource, node_label, host,
-        # claimed_by_cid] — allocate() claims a matching slot and launches
-        # on ITS host, never re-packing greedily (see _store_acquire)
+        # claimed_by_cid, gang_id] — allocate() claims a matching slot and
+        # launches on ITS host, never re-packing greedily (see
+        # _store_acquire); gang_id lets a losing on-demand lease be rolled
+        # back slot-and-all (_store_release_gang)
         self._gang_slots: list[list] = []
         self._hosts = [
             _HostSlot(
@@ -436,7 +439,7 @@ class RemoteBackend(_LeaseRenewalMixin):
                     # re-pack consumes some other host's leftover budget
                     self._gang_slots.append(
                         [ask.resource, ask.node_label,
-                         slot.host if slot is not None else host, ""]
+                         slot.host if slot is not None else host, "", gang_id]
                     )
 
     def reserve_job(self, asks, *, timeout_s: float | None = None, cancel=None) -> None:
@@ -558,9 +561,37 @@ class RemoteBackend(_LeaseRenewalMixin):
                 # host over-consumed or unknown: try another matching slot
         return None
 
+    def _store_release_gang(self, gang_id: str) -> None:
+        """Roll back a losing on-demand lease (nothing launched against
+        it): withdraw its unclaimed slot(s) and host budget, then hand the
+        gang back to the store. A slot a concurrent allocate already
+        claimed stays — its backing lease now belongs to that container —
+        so this can never release capacity that is still in use."""
+        with self._lock:
+            mine = [
+                gs for gs in self._gang_slots
+                if gs[4] == gang_id and gs[3] == ""
+            ]
+            for gs in mine:
+                self._gang_slots.remove(gs)
+                slot = next((h for h in self._hosts if h.host == gs[2]), None)
+                if slot is not None and slot.budget is not None:
+                    slot.budget = slot.budget - gs[0]
+        if not mine:
+            return
+        self._reserved_gangs.discard(gang_id)
+        try:
+            self._store.release_gang(self._app_id, gang_id)
+        except Exception:
+            log.warning(
+                "could not return losing on-demand lease %s (TTL/pid "
+                "reaping will reclaim)", gang_id, exc_info=True,
+            )
+
     def allocate(self, request: ContainerRequest) -> Container:
         if self._stopped:
             raise InsufficientResources("backend stopped")
+        chaos_hook("backend.allocate", task=request.task_id, backend="remote")
         try:
             with self._lock:
                 self._next_id += 1
@@ -581,8 +612,12 @@ class RemoteBackend(_LeaseRenewalMixin):
             # just-granted slot between the store grant and our locked
             # claim, so the loser takes ANOTHER on-demand lease (fresh
             # gang_id — the idempotency guard would no-op a repeat) and
-            # retries; termination is the store's grant-or-raise when
-            # capacity truly runs out. Mirrors LocalProcessBackend.
+            # retries. Mirrors LocalProcessBackend. Each losing lease is
+            # RETURNED to the store before the retry and the loop is
+            # bounded: a store whose view of a host exceeds the local one
+            # (another job registered it first, wider) would otherwise
+            # grant unclaimable leases forever, every one stranded for the
+            # job's lifetime.
             attempt = 0
             while True:
                 gang_id = f"ondemand:{request.task_id}" + (
@@ -615,7 +650,15 @@ class RemoteBackend(_LeaseRenewalMixin):
                     if slot is not None:
                         slot.in_use = slot.in_use + request.resource
                         break
+                self._store_release_gang(gang_id)
                 attempt += 1
+                if attempt >= self.ONDEMAND_MAX_ATTEMPTS:
+                    raise InsufficientResources(
+                        f"on-demand lease for {request.task_id} was "
+                        f"store-granted {attempt} times but never claimable "
+                        "locally (store/local capacity views disagree, or "
+                        "concurrent allocates keep winning)"
+                    )
         if request.log_path:
             os.makedirs(os.path.dirname(request.log_path) or ".", exist_ok=True)
             out: IO[bytes] = open(request.log_path, "ab")
@@ -773,8 +816,10 @@ class RemoteBackend(_LeaseRenewalMixin):
         for t in list(self._waiters.values()):
             t.join(timeout=10)
         if self._store is not None:
-            # the job is over: hand every lease back to the shared RM
-            self._store.release_app(self._app_id)
+            # the job is over: hand every lease back to the shared RM —
+            # bounded (and skipped entirely after a fence), so a hung
+            # store can never wedge teardown before _write_status
+            self._release_store_leases()
             self._reserved_gangs.clear()
             with self._lock:
                 self._gang_slots.clear()

@@ -1,0 +1,222 @@
+"""Minimal TOML-subset reader: the ``tomllib`` fallback for Python < 3.11.
+
+The container image pins Python 3.10 (no stdlib ``tomllib``) and installing
+``tomli`` is off the table, so job configs parse through this subset reader
+instead. It covers exactly the surface tony-tpu configs use — ``[a.b]``
+tables, bare keys, basic strings (with escapes), ints, floats, booleans,
+single- or multi-line arrays, and ``#`` comments — and raises loudly on
+anything fancier (multi-line strings, inline tables, dates, dotted keys),
+so a config silently half-parsed can never reach a job.
+"""
+
+from __future__ import annotations
+
+from typing import Any, BinaryIO
+
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\", "f": "\f", "b": "\b"}
+
+
+class TOMLDecodeError(ValueError):
+    pass
+
+
+def load(fp: BinaryIO) -> dict[str, Any]:
+    """``tomllib.load`` signature parity: read a binary file object."""
+    return loads(fp.read().decode("utf-8"))
+
+
+def loads(text: str) -> dict[str, Any]:
+    root: dict[str, Any] = {}
+    table = root
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = _strip_comment(lines[i]).strip()
+        i += 1
+        if not line:
+            continue
+        if line.startswith("["):
+            if line.startswith("[["):
+                raise TOMLDecodeError(
+                    f"arrays of tables are not supported by the minimal "
+                    f"TOML reader (line {i}): {line!r}"
+                )
+            if not line.endswith("]"):
+                raise TOMLDecodeError(f"malformed table header (line {i}): {line!r}")
+            table = root
+            for part in line[1:-1].strip().split("."):
+                part = part.strip()
+                if not part:
+                    raise TOMLDecodeError(f"empty table name (line {i}): {line!r}")
+                table = table.setdefault(part, {})
+                if not isinstance(table, dict):
+                    raise TOMLDecodeError(f"table {part!r} collides with a value")
+            continue
+        if "=" not in line:
+            raise TOMLDecodeError(f"expected key = value (line {i}): {line!r}")
+        key, _, raw = line.partition("=")
+        key = key.strip().strip('"')
+        raw = raw.strip()
+        # a multi-line array continues until brackets balance outside strings
+        while raw.startswith("[") and _bracket_depth(raw) > 0:
+            if i >= len(lines):
+                raise TOMLDecodeError(f"unterminated array for key {key!r}")
+            raw += " " + _strip_comment(lines[i]).strip()
+            i += 1
+        table[key] = _value(raw, i)
+    return root
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a ``#`` comment, honouring both basic (``"``) and literal
+    (``'``) strings so a '#' inside either survives."""
+    out = []
+    quote = ""  # the active string delimiter, "" when outside strings
+    escaped = False
+    for ch in line:
+        if quote:
+            out.append(ch)
+            if escaped:
+                escaped = False
+            elif ch == "\\" and quote == '"':  # literal strings have no escapes
+                escaped = True
+            elif ch == quote:
+                quote = ""
+            continue
+        if ch == "#":
+            break
+        out.append(ch)
+        if ch in ('"', "'"):
+            quote = ch
+    return "".join(out)
+
+
+def _bracket_depth(raw: str) -> int:
+    depth = 0
+    quote = ""
+    escaped = False
+    for ch in raw:
+        if quote:
+            if escaped:
+                escaped = False
+            elif ch == "\\" and quote == '"':
+                escaped = True
+            elif ch == quote:
+                quote = ""
+            continue
+        if ch in ('"', "'"):
+            quote = ch
+        elif ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+    return depth
+
+
+def _value(raw: str, lineno: int) -> Any:
+    raw = raw.strip()
+    if not raw:
+        raise TOMLDecodeError(f"empty value (line {lineno})")
+    if raw.startswith('"""') or raw.startswith("'''"):
+        raise TOMLDecodeError(f"multi-line strings unsupported (line {lineno})")
+    if raw.startswith('"'):
+        s, rest = _string(raw, lineno)
+        if rest.strip():
+            raise TOMLDecodeError(f"trailing data after string (line {lineno}): {rest!r}")
+        return s
+    if raw.startswith("'"):
+        if not raw.endswith("'") or len(raw) < 2:
+            raise TOMLDecodeError(f"unterminated literal string (line {lineno})")
+        return raw[1:-1]
+    if raw.startswith("["):
+        return _array(raw, lineno)
+    if raw.startswith("{"):
+        raise TOMLDecodeError(f"inline tables unsupported (line {lineno}): {raw!r}")
+    if raw == "true":
+        return True
+    if raw == "false":
+        return False
+    try:
+        return int(raw.replace("_", ""), 0) if raw.lower().startswith(("0x", "0o", "0b", "-0x")) else int(raw.replace("_", ""))
+    except ValueError:
+        pass
+    try:
+        return float(raw.replace("_", ""))
+    except ValueError:
+        pass
+    raise TOMLDecodeError(f"unsupported value (line {lineno}): {raw!r}")
+
+
+def _string(raw: str, lineno: int) -> tuple[str, str]:
+    """Parse a leading basic string; return (value, remainder)."""
+    assert raw[0] == '"'
+    out = []
+    j = 1
+    while j < len(raw):
+        ch = raw[j]
+        if ch == "\\":
+            j += 1
+            if j >= len(raw):
+                break
+            esc = raw[j]
+            if esc == "u" and j + 4 < len(raw):
+                out.append(chr(int(raw[j + 1 : j + 5], 16)))
+                j += 5
+                continue
+            if esc not in _ESCAPES:
+                # 3.11 tomllib rejects unknown escapes; silently passing
+                # them through would ship a different value on 3.10
+                raise TOMLDecodeError(
+                    f"invalid escape \\{esc} in string (line {lineno}): {raw!r}"
+                )
+            out.append(_ESCAPES[esc])
+            j += 1
+            continue
+        if ch == '"':
+            return "".join(out), raw[j + 1 :]
+        out.append(ch)
+        j += 1
+    raise TOMLDecodeError(f"unterminated string (line {lineno}): {raw!r}")
+
+
+def _array(raw: str, lineno: int) -> list:
+    body = raw.strip()
+    if not body.endswith("]"):
+        raise TOMLDecodeError(f"unterminated array (line {lineno}): {raw!r}")
+    body = body[1:-1]
+    items: list = []
+    current = ""
+    depth = 0
+    quote = ""
+    escaped = False
+    for ch in body:
+        if quote:
+            current += ch
+            if escaped:
+                escaped = False
+            elif ch == "\\" and quote == '"':
+                escaped = True
+            elif ch == quote:
+                quote = ""
+            continue
+        if ch in ('"', "'"):
+            quote = ch
+            current += ch
+        elif ch == "[":
+            depth += 1
+            current += ch
+        elif ch == "]":
+            depth -= 1
+            current += ch
+        elif ch == "," and depth == 0:
+            if current.strip():
+                items.append(_value(current, lineno))
+            current = ""
+        else:
+            current += ch
+    if current.strip():
+        items.append(_value(current, lineno))
+    return items
+
+
+__all__ = ["TOMLDecodeError", "load", "loads"]

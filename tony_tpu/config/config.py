@@ -11,7 +11,11 @@ from __future__ import annotations
 
 import json
 import os
-import tomllib
+
+try:
+    import tomllib  # Python 3.11+
+except ModuleNotFoundError:  # 3.10 image: subset reader, same load() surface
+    from tony_tpu.config import _minitoml as tomllib  # type: ignore[no-redef]
 from dataclasses import dataclass, field
 from typing import Any, Iterator
 

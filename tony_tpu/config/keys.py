@@ -94,6 +94,17 @@ class Keys:
     HISTORY_FINISHED_DIR = "history.finished_dir"
     PORTAL_PORT = "portal.port"
 
+    # --- chaos (fault injection; docs/CHAOS.md) ---
+    # master gate: when false (the default) every chaos hook is a no-op and
+    # no fault schedule is ever parsed or armed
+    CHAOS_ENABLED = "chaos.enabled"
+    # declarative fault schedule: a JSON list of fault objects (as a
+    # string — portable across TOML readers), e.g.
+    # [{"type": "kill_container", "task": "worker:0", "at_count": 3}]
+    CHAOS_FAULTS = "chaos.faults"
+    # seed for the injector's RNG (delay jitter); same seed = same schedule
+    CHAOS_SEED = "chaos.seed"
+
 
 # Per-jobtype key suffixes (the ``tony.<jobtype>.<suffix>`` templating scheme).
 JOB_SUFFIXES = (
@@ -166,4 +177,7 @@ DEFAULTS: dict[str, object] = {
     Keys.HISTORY_INTERMEDIATE_DIR: "",
     Keys.HISTORY_FINISHED_DIR: "",
     Keys.PORTAL_PORT: 8080,
+    Keys.CHAOS_ENABLED: False,
+    Keys.CHAOS_FAULTS: "",
+    Keys.CHAOS_SEED: 0,
 }

@@ -22,6 +22,7 @@ import time
 
 import grpc
 
+from tony_tpu.chaos import chaos_hook
 from tony_tpu.config.config import TonyConfig
 from tony_tpu.config.keys import Keys
 from tony_tpu.rpc import ApplicationRpcClient, pb
@@ -108,6 +109,17 @@ class TaskExecutor:
     def _heartbeat_loop(self) -> None:
         interval = self.config.get_int(Keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
         while not self._abort.is_set():
+            # chaos seam: kill_container SIGKILLs this process group here
+            # (the count is this executor's heartbeat number — "at beat N"
+            # is exact); drop_heartbeats returns a suppression and the
+            # beat is skipped while the user process keeps running
+            if chaos_hook(
+                "executor.beat",
+                task=f"{self.job_name}:{self.index}",
+                attempt=self.attempt,
+            ):
+                time.sleep(interval)
+                continue
             try:
                 resp = self.client.heartbeat(self.job_name, self.index, self.attempt)
                 if resp.action == pb.HeartbeatResponse.ABORT:
@@ -219,6 +231,10 @@ def main() -> None:
         format="%(asctime)s EXEC %(levelname)s %(name)s: %(message)s",
     )
     executor = TaskExecutor()
+    # arm fault injection for THIS executor only when the job asks for it
+    from tony_tpu.chaos import install_from_config
+
+    install_from_config(executor.config, role="executor")
     sys.exit(executor.run())
 
 

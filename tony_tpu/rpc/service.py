@@ -16,6 +16,7 @@ from typing import Any, Callable
 
 import grpc
 
+from tony_tpu.chaos import chaos_hook
 from tony_tpu.rpc import tony_pb2 as pb
 
 log = logging.getLogger(__name__)
@@ -76,6 +77,9 @@ class ApplicationRpcServicer:
 
 def _wrap(method: Callable[[Any, Any], Any]) -> Callable[[Any, Any], Any]:
     def handler(request, context):
+        # chaos seam: delay_rpc injects latency into served control-plane
+        # calls (per-method filterable); no-op unless this process armed
+        chaos_hook("rpc.server", method=method.__name__)
         try:
             return method(request, context)
         except NotImplementedError:
