@@ -30,6 +30,8 @@ def main() -> None:
     p.add_argument("--checkpoint-dir", default=os.environ.get("TONY_CHECKPOINT_DIR", ""))
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--attention", default="", help="dot | flash | ring")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="device-prefetch depth (0 = synchronous input path)")
     args = p.parse_args()
 
     # jax.distributed bootstrap happens inside fit() via the TONY_* env.
@@ -48,6 +50,7 @@ def main() -> None:
                 global_batch=args.global_batch,
                 seq_len=args.seq_len,
                 vocab_size=model.vocab_size,
+                prefetch=args.prefetch,
             ),
             steps=args.steps,
             log_every=max(args.steps // 10, 1),

@@ -57,10 +57,51 @@ def main() -> None:
     }
     for s in K.JOB_SUFFIXES:
         lines.append(f"| `{s}` | {suffix_doc.get(s, '')} |")
+    lines += _data_config_section()
     out = os.path.join(os.path.dirname(__file__), "..", "docs", "CONFIG.md")
     with open(out, "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"wrote {os.path.abspath(out)} ({len(lines)} lines)")
+
+
+def _data_config_section() -> list[str]:
+    """Document fit()'s input-pipeline knobs (`data.*` on DataConfig): they
+    are Python-API fields set in the training script, not job-file keys,
+    but belong in the same reference."""
+    import dataclasses
+
+    from tony_tpu.train.data import DataConfig
+
+    notes = {
+        "global_batch": "global batch size, divided evenly across processes",
+        "seq_len": "tokens per sequence (targets are inputs shifted by one)",
+        "vocab_size": "synthetic-stream vocabulary (Zipf marginals)",
+        "seed": "synthetic-stream seed; generation is keyed per (seed, step) "
+                "so checkpoint resume continues the stream exactly",
+        "path": "flat binary int32 token file; empty selects the synthetic "
+                "stream",
+        "native": "route token files through the C++ prefetching loader "
+                  "(shuffled epochs) when it can build; False pins the "
+                  "numpy mmap path",
+        "prefetch": "device-prefetch depth: batches N+1..N+depth are "
+                    "host-generated and device-placed on a background "
+                    "thread while the device runs step N; 0 pins the "
+                    "synchronous legacy path. Stream order and loss "
+                    "trajectory are identical either way (docs/PERF.md "
+                    "\"Overlap\")",
+    }
+    lines = ["", "## Training data (`DataConfig`, Python API)", "",
+             "Set on `FitConfig.data` in the training script (e.g. "
+             "`DataConfig(prefetch=4)`); these are not job-file keys.", "",
+             "| field | default | notes |", "|---|---|---|"]
+    for f in dataclasses.fields(DataConfig):
+        default = f.default
+        default = '""' if default == "" else f"{default}"
+        lines.append(
+            f"| `data.{f.name}` | `{default}` | "
+            f"{notes.get(f.name, '').replace('|', chr(92) + '|')} |"
+        )
+    return lines
 
 
 if __name__ == "__main__":

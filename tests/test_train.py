@@ -25,6 +25,33 @@ def test_synthetic_data_shapes_and_determinism():
     np.testing.assert_array_equal(np.asarray(src[0][:, 1:]), np.asarray(src[1][:, :-1]))
 
 
+def test_synthetic_distribution_is_zipf():
+    """The searchsorted inverse-CDF sampler draws the same Zipf marginals
+    the old rng.choice(p=) path did: empirical token frequencies over a
+    large sample match the analytic probabilities."""
+    from tony_tpu.train.data import synthetic_batches
+
+    vocab = 50
+    cfg = DataConfig(global_batch=8, seq_len=255, vocab_size=vocab, seed=11,
+                     prefetch=0)
+    stream = synthetic_batches(cfg)
+    counts = np.zeros(vocab, dtype=np.int64)
+    total = 0
+    for _ in range(20):
+        inputs, _ = next(stream)
+        flat = np.asarray(inputs).ravel()
+        counts += np.bincount(flat, minlength=vocab)
+        total += flat.size
+    assert counts.min() >= 0 and counts.sum() == total
+    ranks = np.arange(1, vocab + 1)
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    freq = counts / total
+    # ~41k draws: the head of the distribution is tight
+    np.testing.assert_allclose(freq[:5], probs[:5], atol=0.012)
+    # monotone decay across the tail, coarsely
+    assert freq[0] > freq[10] > freq[40]
+
+
 def test_mmap_data_roundtrip(tmp_path):
     from tony_tpu.train.data import mmap_batches
 
@@ -94,6 +121,141 @@ def test_fit_on_token_file_native_loader(tmp_path):
     )
     final = fit(cfg)
     assert np.isfinite(final["final_loss"])
+
+
+def test_prefetch_stream_order_and_exact_resume(tmp_path):
+    """prefetch>0 yields the exact same stream as prefetch=0 (deterministic
+    FIFO ordering), and start_step resumes it mid-stream bitwise."""
+    from tony_tpu.train.data import make_batches
+    from tony_tpu.train.prefetch import PrefetchIterator
+
+    tokens = np.arange(4 * (8 + 1) * 5, dtype=np.int32)
+    path = tmp_path / "tokens.bin"
+    tokens.tofile(path)
+    for kwargs in (
+        dict(global_batch=4, seq_len=16, vocab_size=97, seed=3),   # synthetic
+        dict(global_batch=4, seq_len=8, path=str(path), native=False),  # mmap
+    ):
+        sync = make_batches(DataConfig(prefetch=0, **kwargs))
+        assert not isinstance(sync, PrefetchIterator)  # legacy path untouched
+        want = [next(sync) for _ in range(5)]
+
+        pre = make_batches(DataConfig(prefetch=2, **kwargs))
+        assert isinstance(pre, PrefetchIterator)
+        got = [next(pre) for _ in range(5)]
+        pre.close()
+        for (wi, wt), (gi, gt) in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(wi), np.asarray(gi))
+            np.testing.assert_array_equal(np.asarray(wt), np.asarray(gt))
+
+        resumed = make_batches(DataConfig(prefetch=2, **kwargs), start_step=2)
+        for wi, wt in want[2:]:
+            gi, gt = next(resumed)
+            np.testing.assert_array_equal(np.asarray(wi), np.asarray(gi))
+            np.testing.assert_array_equal(np.asarray(wt), np.asarray(gt))
+        resumed.close()
+
+
+def test_prefetch_clean_shutdown_no_leaked_threads():
+    import threading
+
+    from tony_tpu.train.data import make_batches
+
+    def prefetch_threads():
+        return [
+            t for t in threading.enumerate()
+            if t.name.startswith("tony-prefetch") and t.is_alive()
+        ]
+
+    before = len(prefetch_threads())
+    stream = make_batches(DataConfig(global_batch=4, seq_len=16, vocab_size=97,
+                                     prefetch=3))
+    next(stream)
+    assert len(prefetch_threads()) == before + 1
+    stream.close()
+    assert len(prefetch_threads()) == before
+    # close is idempotent and next() after close doesn't hang
+    stream.close()
+
+
+def test_prefetch_propagates_producer_error():
+    from tony_tpu.train.prefetch import PrefetchIterator
+
+    def boom():
+        yield 1
+        raise RuntimeError("producer died")
+
+    it = PrefetchIterator(boom(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="producer died"):
+        # the producer may need a moment to post the error
+        for _ in range(3):
+            next(it)
+    it.close()
+
+
+def test_prefetch_bitwise_identical_loss_trajectory():
+    """prefetch=0 vs prefetch=2 must produce the SAME training run: same
+    per-step losses (the stream order and content are identical, and the
+    overlapped loop changes only when work happens, not what runs)."""
+    import dataclasses
+
+    def run(depth):
+        seen = []
+        cfg = FitConfig(
+            model=LlamaConfig.tiny(),
+            data=DataConfig(global_batch=4, seq_len=32, vocab_size=256,
+                            prefetch=depth),
+            mesh_shape=MeshShape(fsdp=2),
+            steps=4,
+            log_every=1,
+            lr=5e-3,
+            warmup_steps=2,
+            on_metrics=lambda m: seen.append((m["step"], m["loss"], m["grad_norm"])),
+        )
+        final = fit(cfg)
+        return seen, final["final_loss"]
+
+    seen0, final0 = run(0)
+    seen2, final2 = run(2)
+    assert len(seen0) == 4 and seen0 == seen2
+    assert final0 == final2  # exact float equality, not allclose
+
+
+def test_reporter_queue_overflow_drops_instead_of_blocking():
+    """A stalled AM RPC can't block the step loop: push() enqueues, the
+    overflow increments the drop counter, and the counter is surfaced as a
+    metrics_dropped sample on the next successful push."""
+    import threading
+    import time as _time
+
+    from tony_tpu.obs.reporter import MetricsReporter
+
+    class SlowClient:
+        def __init__(self):
+            self.release = threading.Event()
+            self.sent = []
+
+        def push_metrics(self, job_name, index, samples):
+            assert self.release.wait(timeout=10)
+            self.sent.append(samples)
+
+        def close(self):
+            self.release.set()
+
+    client = SlowClient()
+    rep = MetricsReporter(client=client, maxsize=2)
+    assert rep.active
+    t0 = _time.perf_counter()
+    for i in range(20):
+        rep.push({"step": i + 1, "loss": 1.0})
+    assert _time.perf_counter() - t0 < 1.0  # never blocked on the stall
+    assert rep.dropped >= 10
+    client.release.set()  # un-wedge the AM; close() flushes the queue
+    rep.close()
+    assert len(client.sent) >= 1
+    names = {n for batch in client.sent for (n, _, _) in batch}
+    assert "metrics_dropped" in names
 
 
 def test_fit_loss_decreases_tiny_model(tmp_path):

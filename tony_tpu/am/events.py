@@ -89,7 +89,7 @@ class EventWriter:
         return self._path
 
 
-def submit_latency(app_dir: str) -> dict[str, float]:
+def submit_latency(app_dir: str) -> dict:
     """AM-submit -> first-training-step latency, with a phase breakdown.
 
     The north-star latency metric (SURVEY.md section 3.1: "the only
@@ -102,6 +102,9 @@ def submit_latency(app_dir: str) -> dict[str, float]:
     - ``task_started_s`` — + container allocation/launch (first TASK_STARTED)
     - ``registered_s``   — + executor boot/registration (first TASK_REGISTERED)
     - ``first_step_s``   — + gang barrier, jax/dist init, compile, step 1
+    - ``startup``        — fit()'s in-worker breakdown of that last gap
+      (``compile_s`` / ``restore_s`` / ``first_batch_s``), when the job
+      pushed one (overlapped phases, so they need not sum to the gap)
 
     Raises ``FileNotFoundError``/``ValueError`` when the app dir predates
     this instrumentation or no step metric was ever pushed.
@@ -115,15 +118,26 @@ def submit_latency(app_dir: str) -> dict[str, float]:
         for e in events:
             if pred(e):
                 out[key] = round(e["ts"] - t0, 3)
-                return
+                return e
     first(lambda e: e["type"] == EventType.APPLICATION_INITED, "am_inited_s")
     first(lambda e: e["type"] == EventType.TASK_STARTED, "task_started_s")
     first(lambda e: e["type"] == EventType.TASK_REGISTERED, "registered_s")
-    first(
+    first_step_event = first(
         lambda e: e["type"] == EventType.METRICS
         and e.get("samples", {}).get("step", 0) >= 1,
         "first_step_s",
     )
+    if first_step_event is not None:
+        # fit() attaches a startup-phase breakdown (compile vs restore vs
+        # first-batch, as startup_* samples) to its first step push; surface
+        # it so the latency bench shows where the first-step gap went
+        phases = {
+            k[len("startup_"):]: v
+            for k, v in first_step_event["samples"].items()
+            if k.startswith("startup_")
+        }
+        if phases:
+            out["startup"] = phases
     if "first_step_s" not in out:
         raise ValueError(
             f"no step METRICS event in {app_dir} (job not using fit(), or "

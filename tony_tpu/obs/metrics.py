@@ -41,10 +41,28 @@ class StepTimer:
     n_chips: int = 1
     elapsed_s: float = 0.0
     steps: int = 0
+    # wall time the host spent blocked producing/placing input batches
+    # (time inside next(batches)); the device is idle for that span unless
+    # the data layer prefetches (train/prefetch.py)
+    host_blocked_s: float = 0.0
 
-    def record(self, dt_s: float, n_steps: int = 1) -> None:
+    def record(self, dt_s: float, n_steps: int = 1, host_blocked_s: float = 0.0) -> None:
         self.elapsed_s += dt_s
         self.steps += n_steps
+        self.host_blocked_s += host_blocked_s
+
+    @property
+    def host_blocked_ms_per_step(self) -> float:
+        if self.steps == 0:
+            return 0.0
+        return self.host_blocked_s / self.steps * 1e3
+
+    @property
+    def host_blocked_frac(self) -> float:
+        """Fraction of wall time spent input-blocked (0 = stall-free loop)."""
+        if self.elapsed_s == 0:
+            return 0.0
+        return self.host_blocked_s / self.elapsed_s
 
     @property
     def tokens_per_sec(self) -> float:

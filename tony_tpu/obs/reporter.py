@@ -6,12 +6,21 @@ executor's generic cpu/rss sampler, the *training* process can push
 step-level throughput/loss/MFU — the numbers that actually matter on TPU —
 through the same channel. fit() wires this automatically when running under
 a tony-tpu job (the TONY_AM_ADDR env is present).
+
+Pushes are asynchronous: ``push()`` enqueues onto a bounded queue drained
+by a daemon thread, so a stalled or tearing-down AM can never block the
+train loop (an RPC hang used to stall the step for up to the 3s client
+timeout). When the queue is full the sample is dropped and counted;
+``dropped`` rides along as a ``metrics_dropped`` sample on the next
+successful push so the loss is visible in the job history, not silent.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import queue
+import threading
 import time
 
 log = logging.getLogger(__name__)
@@ -20,39 +29,65 @@ log = logging.getLogger(__name__)
 class MetricsReporter:
     """Best-effort pusher; never lets metrics failures hurt training."""
 
-    def __init__(self) -> None:
-        self._client = None
+    def __init__(self, client=None, maxsize: int = 64) -> None:
+        self._client = client
+        self.dropped = 0  # samples lost to a full queue (training never waits)
         self.job_name = os.environ.get("TONY_JOB_NAME", "")
         self.index = int(os.environ.get("TONY_TASK_INDEX", "0"))
-        addr = os.environ.get("TONY_AM_ADDR", "")
-        if not addr:
-            return
-        try:
-            from tony_tpu.rpc import ApplicationRpcClient
-            from tony_tpu.rpc.auth import read_token
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if self._client is None:
+            addr = os.environ.get("TONY_AM_ADDR", "")
+            if not addr:
+                return
+            try:
+                from tony_tpu.rpc import ApplicationRpcClient
+                from tony_tpu.rpc.auth import read_token
 
-            token = read_token(os.environ.get("TONY_APP_DIR", ""))
-            self._client = ApplicationRpcClient(addr, timeout_s=3.0, token=token)
-        except Exception:
-            log.debug("metrics reporter disabled", exc_info=True)
+                token = read_token(os.environ.get("TONY_APP_DIR", ""))
+                self._client = ApplicationRpcClient(addr, timeout_s=3.0, token=token)
+            except Exception:
+                log.debug("metrics reporter disabled", exc_info=True)
+                return
+        self._thread = threading.Thread(
+            target=self._drain, name="tony-metrics-push", daemon=True
+        )
+        self._thread.start()
 
     @property
     def active(self) -> bool:
         return self._client is not None
 
     def push(self, metrics: dict) -> None:
+        """Enqueue; never blocks. A full queue (AM slower than the step
+        cadence) drops the sample and bumps ``dropped``."""
         if self._client is None:
             return
-        now = time.time()
-        samples = [
-            (k, float(v), now)
-            for k, v in metrics.items()
-            if isinstance(v, (int, float))
-        ]
         try:
-            self._client.push_metrics(self.job_name, self.index, samples)
-        except Exception:
-            pass  # AM busy/tearing down; training goes on
+            self._q.put_nowait((dict(metrics), time.time()))
+        except queue.Full:
+            self.dropped += 1
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                metrics, ts = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            samples = [
+                (k, float(v), ts)
+                for k, v in metrics.items()
+                if isinstance(v, (int, float))
+            ]
+            if self.dropped:
+                samples.append(("metrics_dropped", float(self.dropped), ts))
+            try:
+                self._client.push_metrics(self.job_name, self.index, samples)
+            except Exception:
+                pass  # AM busy/tearing down; training goes on
 
     def register_tensorboard(self, url: str) -> None:
         if self._client is None:
@@ -62,7 +97,19 @@ class MetricsReporter:
         except Exception:
             pass
 
-    def close(self) -> None:
+    def close(self, timeout: float = 5.0) -> None:
+        """Flush what the drain thread can send within ``timeout`` and shut
+        down. A wedged AM RPC cannot hang shutdown: the thread is a daemon
+        and is abandoned after the join timeout."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        if self.dropped:
+            # a permanently wedged AM means no metrics_dropped sample ever
+            # reached the history — make the loss visible in worker logs too
+            log.warning("%d metric pushes dropped (AM slower than the step "
+                        "cadence or unreachable)", self.dropped)
         if self._client is not None:
             self._client.close()
             self._client = None

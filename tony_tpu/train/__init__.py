@@ -2,6 +2,7 @@
 
 from tony_tpu.train.data import DataConfig, make_batches
 from tony_tpu.train.loop import FitConfig, fit
+from tony_tpu.train.prefetch import PrefetchIterator
 from tony_tpu.train.trainer import (
     TrainState,
     default_optimizer,
@@ -13,6 +14,7 @@ from tony_tpu.train.trainer import (
 __all__ = [
     "DataConfig",
     "FitConfig",
+    "PrefetchIterator",
     "TrainState",
     "default_optimizer",
     "fit",

@@ -36,6 +36,11 @@ class DataConfig:
     # IO off the GIL) when it can build; False pins the numpy mmap path
     # (deterministic sequential windows)
     native: bool = True
+    # device-prefetch depth: batches N+1..N+prefetch are host-generated and
+    # device-placed on a background thread while the device runs step N
+    # (train/prefetch.py). 0 pins the legacy synchronous path. The stream
+    # order is identical either way (FIFO, single producer).
+    prefetch: int = 2
 
 
 def _local_slice(global_batch: int) -> tuple[int, int]:
@@ -56,12 +61,20 @@ def synthetic_batches(
     per, _ = _local_slice(cfg.global_batch)
     ranks = np.arange(1, cfg.vocab_size + 1, dtype=np.float64)
     probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    # inverse-CDF sampling over a cumulative table built ONCE: rng.choice(p=)
+    # rebuilds its alias/sampling setup every call, which at vocab 32k was
+    # the dominant host cost per batch. searchsorted(cum, U) draws the same
+    # Zipf marginals (token t iff cum[t-1] <= U < cum[t]); the tail is
+    # pinned to 1.0 so float rounding can never index past vocab_size-1.
+    cum = np.cumsum(probs)
+    cum[-1] = 1.0
     step = start_step
     while True:
         rng = np.random.default_rng((cfg.seed + jax.process_index(), step))
-        tokens = rng.choice(cfg.vocab_size, size=(per, cfg.seq_len + 1), p=probs)
+        draws = rng.random((per, cfg.seq_len + 1))
+        tokens = np.searchsorted(cum, draws, side="right").astype(np.int32)
         step += 1
-        yield _to_global(tokens.astype(np.int32), sharding)
+        yield _to_global(tokens, sharding)
 
 
 def mmap_batches(
@@ -84,13 +97,45 @@ def mmap_batches(
     step = start_step
     while True:
         pos = (step % steps_per_epoch) * stride + off * window
-        chunk = np.asarray(data[pos : pos + per * window]).reshape(per, window)
+        chunk = data[pos : pos + per * window].reshape(per, window)
+        # One contiguous copy per array instead of two strided views into
+        # the page cache: the sharding assembler can then zero-copy whole
+        # row-contiguous shards. The pair must be freshly owned by its
+        # batch — jax's CPU device_put aliases compatible host buffers, so
+        # a reused/preallocated ring would let a later copy corrupt a batch
+        # still queued on device (breaks prefetch>0 determinism).
+        out = (
+            np.empty((per, cfg.seq_len), np.int32),
+            np.empty((per, cfg.seq_len), np.int32),
+        )
         step += 1
-        yield _to_global(chunk, sharding)
+        yield _to_global(chunk, sharding, out=out)
 
 
-def _to_global(tokens: np.ndarray, sharding: NamedSharding | None) -> Batch:
-    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+def _to_global(
+    tokens: np.ndarray,
+    sharding: NamedSharding | None,
+    out: tuple[np.ndarray, np.ndarray] | None = None,
+) -> Batch:
+    """Shift ``tokens`` into (inputs, targets) and assemble device arrays.
+
+    ``out`` is an optional preallocated (inputs, targets) buffer pair: the
+    shifted slices are written there in one contiguous pass each, so the
+    assembler receives C-contiguous in-memory arrays instead of strided
+    views into an mmap.
+    """
+    if out is not None:
+        inputs, targets = out
+        np.copyto(inputs, tokens[:, :-1])
+        np.copyto(targets, tokens[:, 1:])
+    else:
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    return _assemble(inputs, targets, sharding)
+
+
+def _assemble(
+    inputs: np.ndarray, targets: np.ndarray, sharding: NamedSharding | None
+) -> Batch:
     if sharding is None:
         return jnp.asarray(inputs), jnp.asarray(targets)
     return (
@@ -117,12 +162,39 @@ def native_batches(
         n_shards=jax.process_count(), shard_id=jax.process_index(),
         seed=cfg.seed,
     )
-    loader.seek(start_step)
-    while True:
-        yield _to_global(loader.next(), sharding)
+    try:
+        loader.seek(start_step)
+        while True:
+            # fresh owned contiguous pair per batch (same aliasing rule as
+            # mmap_batches), filled by the loader without an extra copy
+            out = (
+                np.empty((per, cfg.seq_len), np.int32),
+                np.empty((per, cfg.seq_len), np.int32),
+            )
+            loader.next_into(*out)
+            yield _assemble(out[0], out[1], sharding)
+    finally:
+        # generator close (incl. PrefetchIterator.close / GC) frees the
+        # native handle + mmap deterministically
+        loader.close()
 
 
 def make_batches(
+    cfg: DataConfig, sharding: NamedSharding | None = None, start_step: int = 0
+) -> Iterator[Batch]:
+    """Build the configured batch stream; with ``cfg.prefetch > 0`` it is
+    wrapped in a :class:`~tony_tpu.train.prefetch.PrefetchIterator` (same
+    element order, host+H2D work overlapped with the device step). Streams
+    that own a thread expose ``close()``; ``fit()`` calls it on exit."""
+    it = _make_batches_raw(cfg, sharding, start_step)
+    if cfg.prefetch > 0:
+        from tony_tpu.train.prefetch import PrefetchIterator
+
+        return PrefetchIterator(it, depth=cfg.prefetch)
+    return it
+
+
+def _make_batches_raw(
     cfg: DataConfig, sharding: NamedSharding | None = None, start_step: int = 0
 ) -> Iterator[Batch]:
     if cfg.path:

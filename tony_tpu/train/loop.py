@@ -8,17 +8,35 @@ metrics. A complete distributed trainer is:
 
     from tony_tpu.train import fit, FitConfig
     fit(FitConfig(model=LlamaConfig.llama2_7b(), steps=1000, ...))
+
+Startup and the steady-state loop are overlapped (docs/PERF.md "Overlap"):
+
+- **compile-ahead**: the train step is AOT-lowered and compiled on a worker
+  thread, concurrently with sharded state init, checkpoint restore, and
+  input warmup — registered->first-step pays max(compile, restore,
+  first-batch) instead of their sum, compounding with the persistent XLA
+  cache (TONY_JAX_CACHE_DIR).
+- **device prefetch**: with DataConfig.prefetch > 0 (default 2) the batch
+  stream runs on a background thread (train/prefetch.py), so host batch
+  synthesis + H2D placement for step N+1 overlap the device's step N.
+- **stall-free telemetry**: metrics pushes are queued to a daemon thread
+  (obs/reporter.py) and the log-boundary device sync is deferred until the
+  next step is dispatched, so neither an AM RPC stall nor a loss fetch
+  drains the pipeline. The very first step still syncs and pushes
+  immediately — it timestamps the submit->first-step north-star metric.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from tony_tpu.models.llama import LlamaConfig, train_flops_per_token
@@ -27,7 +45,13 @@ from tony_tpu.parallel.mesh import MeshShape, build_mesh
 from tony_tpu.parallel.sharding import DEFAULT_RULES, Rules, spec_for
 from tony_tpu.runtime import jax_tpu
 from tony_tpu.train.data import DataConfig, make_batches
-from tony_tpu.train.trainer import default_optimizer, make_train_state, make_train_step
+from tony_tpu.train.prefetch import close_batches
+from tony_tpu.train.trainer import (
+    default_optimizer,
+    make_train_state,
+    make_train_step,
+    train_state_avals,
+)
 
 log = logging.getLogger(__name__)
 
@@ -53,6 +77,12 @@ class FitConfig:
     # hook called every log_every steps with a metrics dict (obs -> AM push)
     on_metrics: Callable[[dict], None] | None = None
     resume: bool = True  # restore from checkpoint_dir if a checkpoint exists
+    # AOT-compile the train step on a worker thread during startup (overlaps
+    # state init / restore / input warmup); False pins the lazy jit path
+    compile_ahead: bool = True
+    # Adam first-moment dtype ('float32' | 'bfloat16'); bf16 frees
+    # 2 bytes/param of HBM (see default_optimizer / docs/PERF.md)
+    mu_dtype: str = "float32"
 
     def apply_job_env(self) -> None:
         """Fill unset checkpoint fields from the TONY_CHECKPOINT_* env the
@@ -78,6 +108,18 @@ def fit(cfg: FitConfig) -> dict:
         return _fit(cfg)
 
 
+def _start_async_host_copy(metrics: dict) -> None:
+    """Kick off D2H transfers for the scalars a log boundary will read, so
+    the later float() is a cheap wait instead of a fresh blocking fetch."""
+    for key in ("loss", "grad_norm"):
+        arr = metrics.get(key)
+        if hasattr(arr, "copy_to_host_async"):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass
+
+
 def _fit(cfg: FitConfig) -> dict:
     jax_tpu.initialize()  # no-op outside a tony-tpu job
     cfg.apply_job_env()
@@ -99,7 +141,9 @@ def _fit(cfg: FitConfig) -> dict:
     reporter = None
     on_metrics = cfg.on_metrics
     if on_metrics is None and jax_tpu.in_tony_job():
-        # push step metrics to the AM (TaskMonitor/MetricsRpc pipeline)
+        # push step metrics to the AM (TaskMonitor/MetricsRpc pipeline);
+        # pushes are queued + drained by a daemon thread so an AM stall
+        # can never block the step loop
         from tony_tpu.obs.reporter import MetricsReporter
 
         reporter = MetricsReporter()
@@ -114,18 +158,52 @@ def _fit(cfg: FitConfig) -> dict:
         log.info("mesh: %s over %d devices", dict(mesh.shape), mesh.size)
 
     optimizer = default_optimizer(
-        lr=cfg.lr, warmup_steps=cfg.warmup_steps, decay_steps=max(cfg.steps, cfg.warmup_steps + 1)
+        lr=cfg.lr, warmup_steps=cfg.warmup_steps,
+        decay_steps=max(cfg.steps, cfg.warmup_steps + 1),
+        mu_dtype=jnp.dtype(cfg.mu_dtype),
     )
     rules = cfg.rules
     if int(mesh.shape.get("pp", 1)) > 1:
         from tony_tpu.train.trainer import pp_rules
 
         rules = pp_rules(rules)
-    state = make_train_state(jax.random.key(0), cfg.model, mesh, optimizer, rules)
     step_fn = make_train_step(
         cfg.model, mesh, optimizer, rules,
         n_microbatches=cfg.pp_microbatches, pp_schedule=cfg.pp_schedule,
     )
+
+    # --- compile-ahead: AOT-lower/compile the step on a worker thread while
+    # the main thread initialises state, restores the checkpoint, and the
+    # prefetcher warms the input pipeline. Shapes suffice to lower (the jit
+    # carries in_shardings), so no array needs to exist yet.
+    startup: dict[str, float] = {}
+    aot: dict[str, object] = {}
+    compile_thread = None
+    if cfg.compile_ahead:
+        state_avals = train_state_avals(cfg.model, optimizer)
+        batch_aval = jax.ShapeDtypeStruct(
+            (cfg.data.global_batch, cfg.data.seq_len), jnp.int32
+        )
+
+        def _compile_ahead() -> None:
+            t0 = time.perf_counter()
+            try:
+                aot["step"] = step_fn.lower(
+                    state_avals, batch_aval, batch_aval
+                ).compile()
+            except Exception:
+                log.debug(
+                    "compile-ahead failed; jit dispatch compiles lazily",
+                    exc_info=True,
+                )
+            startup["compile_s"] = round(time.perf_counter() - t0, 3)
+
+        compile_thread = threading.Thread(
+            target=_compile_ahead, name="tony-compile-ahead", daemon=True
+        )
+        compile_thread.start()
+
+    state = make_train_state(jax.random.key(0), cfg.model, mesh, optimizer, rules)
 
     manager = None
     start_step = 0
@@ -138,67 +216,159 @@ def _fit(cfg: FitConfig) -> dict:
             save_interval_steps=cfg.checkpoint_every,
         )
         if cfg.resume:
+            t0 = time.perf_counter()
             state, restored = manager.restore(state)
+            startup["restore_s"] = round(time.perf_counter() - t0, 3)
             if restored >= 0:
                 start_step = restored
                 log.info("resumed from checkpoint step %d", restored)
 
     batch_sharding = NamedSharding(mesh, spec_for(("batch", "seq"), cfg.rules))
+    # the prefetch producer (data.prefetch > 0) starts generating + placing
+    # batches here, concurrent with the compile-ahead join below
     batches = make_batches(cfg.data, batch_sharding, start_step=start_step)
+    if compile_thread is not None:
+        compile_thread.join()
+    compiled_step = aot.get("step")
+
     flops_per_token = train_flops_per_token(cfg.model, cfg.data.seq_len)
     tokens_per_step = cfg.data.global_batch * cfg.data.seq_len
 
-    metrics = {}
+    def _emit(snap: dict) -> None:
+        """Resolve a log boundary: device sync on the (already in-flight)
+        scalars, then log + push. Called AFTER the next step is dispatched,
+        so the sync never leaves the device idle."""
+        m = snap["metrics"]
+        loss = float(m["loss"])  # device sync point
+        timer = StepTimer(
+            flops_per_token=flops_per_token,
+            tokens_per_step=tokens_per_step,
+            n_chips=mesh.size,
+        )
+        timer.record(snap["dt"], snap["window"], host_blocked_s=snap["host_s"])
+        out = {
+            "step": snap["step"],
+            "loss": round(loss, 4),
+            "tokens_per_sec": round(timer.tokens_per_sec, 1),
+            "tokens_per_sec_per_chip": round(timer.tokens_per_sec_per_chip, 1),
+            "mfu": round(timer.mfu(), 4),
+            "grad_norm": round(float(m["grad_norm"]), 4),
+            "host_blocked_ms_per_step": round(timer.host_blocked_ms_per_step, 2),
+        }
+        if snap.get("startup"):
+            # first step only: the startup-phase breakdown rides the first
+            # METRICS push so submit_latency() can report compile vs restore
+            # vs first-batch (am/events.py)
+            out.update({f"startup_{k}": v for k, v in snap["startup"].items()})
+        # HBM usage from the device this process owns (the nvidia-smi
+        # sampling analogue; empty on platforms without memory_stats)
+        from tony_tpu.obs.tpu_metrics import tpu_metrics_dict
+
+        out.update(tpu_metrics_dict())
+        if jax.process_index() == 0:
+            log.info(
+                "step %(step)d loss=%(loss)s %(tokens_per_sec_per_chip)s tok/s/chip "
+                "mfu=%(mfu)s", out,
+            )
+        if on_metrics:
+            on_metrics(out)
+
+    metrics: dict = {}
+    pending = None          # boundary snapshot deferred past the next dispatch
+    host_window_s = 0.0     # input-blocked time in the current log window
+    host_steady_s = 0.0     # input-blocked time after the first step
+    steady_t0 = None        # wall clock after the first step fully resolved
     t_window = time.perf_counter()
     window = 0
-    for step in range(start_step, cfg.steps):
-        inputs, targets = next(batches)
-        state, metrics = step_fn(state, inputs, targets)
-        window += 1
-        # the very first step always logs/pushes: it closes the AM-submit ->
-        # first-step loop (the north-star latency metric — the AM timestamps
-        # the resulting METRICS event) and gives users signal before a long
-        # log_every window elapses
-        if step == start_step or (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
-            loss = float(metrics["loss"])  # device sync point
-            timer = StepTimer(
-                flops_per_token=flops_per_token,
-                tokens_per_step=tokens_per_step,
-                n_chips=mesh.size,
-            )
-            timer.record(time.perf_counter() - t_window, window)
-            out = {
-                "step": step + 1,
-                "loss": round(loss, 4),
-                "tokens_per_sec": round(timer.tokens_per_sec, 1),
-                "tokens_per_sec_per_chip": round(timer.tokens_per_sec_per_chip, 1),
-                "mfu": round(timer.mfu(), 4),
-                "grad_norm": round(float(metrics["grad_norm"]), 4),
-            }
-            # HBM usage from the device this process owns (the nvidia-smi
-            # sampling analogue; empty on platforms without memory_stats)
-            from tony_tpu.obs.tpu_metrics import tpu_metrics_dict
-
-            out.update(tpu_metrics_dict())
-            if jax.process_index() == 0:
-                log.info(
-                    "step %(step)d loss=%(loss)s %(tokens_per_sec_per_chip)s tok/s/chip "
-                    "mfu=%(mfu)s", out,
-                )
-            if on_metrics:
-                on_metrics(out)
-            t_window = time.perf_counter()
-            window = 0
-        if manager is not None and manager.should_save(step + 1):
-            manager.save(step + 1, state)
+    try:
+        for step in range(start_step, cfg.steps):
+            t_fetch = time.perf_counter()
+            inputs, targets = next(batches)
+            fetch_s = time.perf_counter() - t_fetch
+            if step == start_step:
+                startup["first_batch_s"] = round(fetch_s, 3)
+            else:
+                host_window_s += fetch_s
+                host_steady_s += fetch_s
+            if compiled_step is not None:
+                try:
+                    state, metrics = compiled_step(state, inputs, targets)
+                except (TypeError, ValueError):
+                    # aval/sharding mismatch between the AOT signature and
+                    # the live arrays (raised before execution, so nothing
+                    # was donated) — fall back to jit dispatch permanently;
+                    # real runtime faults (OOM etc.) propagate as usual
+                    log.warning(
+                        "compile-ahead executable rejected live args; "
+                        "falling back to jit dispatch", exc_info=True,
+                    )
+                    compiled_step = None
+                    state, metrics = step_fn(state, inputs, targets)
+            else:
+                state, metrics = step_fn(state, inputs, targets)
+            window += 1
+            if pending is not None:
+                _emit(pending)  # previous boundary, now that N+1 is in flight
+                pending = None
+            # the very first step always logs/pushes: it closes the AM-submit
+            # -> first-step loop (the north-star latency metric — the AM
+            # timestamps the resulting METRICS event) and gives users signal
+            # before a long log_every window elapses
+            if step == start_step or (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                now = time.perf_counter()
+                snap = {
+                    "step": step + 1,
+                    "metrics": metrics,
+                    "dt": now - t_window,
+                    "window": window,
+                    "host_s": host_window_s,
+                    "startup": dict(startup) if step == start_step else None,
+                }
+                _start_async_host_copy(metrics)
+                if step == start_step or step + 1 == cfg.steps:
+                    # first step: latency metric, sync now; last step: the
+                    # loop ends here, nothing left to overlap with
+                    _emit(snap)
+                else:
+                    pending = snap
+                t_window = time.perf_counter()
+                window = 0
+                host_window_s = 0.0
+                if step == start_step:
+                    steady_t0 = time.perf_counter()
+            if manager is not None and manager.should_save(step + 1):
+                manager.save(step + 1, state)
+        if pending is not None:
+            _emit(pending)
+            pending = None
+        steady_end = time.perf_counter()  # before checkpoint settling
+    finally:
+        close_batches(batches)
     if manager is not None:
         manager.wait()  # settle async saves before checking what exists
         if manager.latest_step() != cfg.steps:
             manager.save(cfg.steps, state, force=True)
         manager.close()
+    final = {"final_loss": float(metrics.get("loss", float("nan"))), "steps": cfg.steps}
     if reporter is not None:
         reporter.close()
-    final = {"final_loss": float(metrics.get("loss", float("nan"))), "steps": cfg.steps}
+        if reporter.dropped:
+            final["metrics_dropped"] = reporter.dropped
+    # steady-state input-stall + throughput accounting (first step excluded:
+    # it absorbs warmup). The last boundary _emit synced the final step, so
+    # the wall-clock window below covers completed work only.
+    steady_steps = max(cfg.steps - start_step - 1, 0)
+    if steady_t0 is not None and steady_steps > 0:
+        steady_elapsed = max(steady_end - steady_t0, 1e-9)
+        final["tokens_per_sec_per_chip"] = round(
+            steady_steps * tokens_per_step / steady_elapsed / mesh.size, 1
+        )
+        final["host_blocked_ms_per_step"] = round(
+            host_steady_s / steady_steps * 1e3, 2
+        )
+        final["host_blocked_frac"] = round(host_steady_s / steady_elapsed, 4)
+    if startup:
+        final["startup"] = dict(startup)
     return final
 
 

@@ -120,6 +120,18 @@ class NativeTokenLoader:
             raise RuntimeError("native loader stopped")
         return self._buf.copy()
 
+    def next_into(self, inputs: np.ndarray, targets: np.ndarray) -> None:
+        """Read the next window's pre-shifted (inputs, targets) pair directly
+        into caller-owned contiguous buffers — skips next()'s intermediate
+        defensive copy (the data layer's single-contiguous-copy contract)."""
+        rc = self._lib.tl_next(
+            self._handle, self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        )
+        if rc != 0:
+            raise RuntimeError("native loader stopped")
+        np.copyto(inputs, self._buf[:, :-1])
+        np.copyto(targets, self._buf[:, 1:])
+
     def __iter__(self) -> Iterator[np.ndarray]:
         while True:
             yield self.next()

@@ -1,0 +1,141 @@
+"""Bounded device-prefetch for batch streams: overlap host input work with
+the device step.
+
+Every ``make_batches`` stream does real host work per step — synthetic token
+sampling or an mmap window copy, then the H2D transfer inside
+``jax.make_array_from_process_local_data`` — and the train loop used to pay
+it synchronously between dispatches, so the device idled while the host
+built batch N+1. :class:`PrefetchIterator` moves that work to a single
+background thread feeding a bounded FIFO queue: while the device executes
+step N, batches N+1..N+depth are generated and placed, and the loop's
+``next()`` is a queue pop.
+
+Guarantees (pinned by tests/test_train.py):
+
+- **Deterministic ordering** — one producer thread, one FIFO queue: the
+  consumer sees exactly the wrapped iterator's sequence, so ``prefetch=0``
+  and ``prefetch>0`` yield bitwise-identical streams.
+- **Exact resume** — resume position is the wrapped iterator's business
+  (``make_batches(..., start_step=N)``); the prefetcher never skips or
+  buffers across a restart because each fit() builds a fresh instance.
+- **Clean shutdown** — ``close()`` (also ``__exit__``/``__del__``) stops
+  the producer and joins it; no thread outlives the iterator.
+- **Error transparency** — an exception in the producer (bad token file,
+  device OOM) is re-raised from the consumer's ``next()``, not swallowed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, TypeVar
+
+T = TypeVar("T")
+
+_END = object()  # wrapped iterator exhausted
+
+
+class PrefetchIterator(Iterator[T]):
+    """Wrap ``it`` so up to ``depth`` items are produced ahead of the
+    consumer on a daemon thread. ``depth`` must be >= 1 (callers gate the
+    synchronous path themselves; see ``make_batches``)."""
+
+    def __init__(self, it: Iterator[T], depth: int = 2, name: str = "tony-prefetch"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._it = it  # kept so close() can release the stream's resources
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._produce, args=(it,), name=name, daemon=True
+        )
+        self._thread.start()
+
+    def _produce(self, it: Iterator[T]) -> None:
+        try:
+            for item in it:
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    return
+        except BaseException as e:  # surfaced from next(), incl. KeyboardInterrupt
+            self._err = e
+        # unblock a consumer waiting on get() (exhaustion or error)
+        while not self._stop.is_set():
+            try:
+                self._q.put(_END, timeout=0.1)
+                break
+            except queue.Full:
+                continue
+
+    def __iter__(self) -> "PrefetchIterator[T]":
+        return self
+
+    def __next__(self) -> T:
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # producer died without posting _END (should not happen,
+                    # but never hang the train loop on it)
+                    if self._err is not None:
+                        raise self._err
+                    raise StopIteration
+                continue
+            if item is _END:
+                self._q.put(_END)  # keep subsequent next() calls terminal
+                if self._err is not None:
+                    raise self._err
+                raise StopIteration
+            return item
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the producer and join it; safe to call more than once."""
+        self._stop.set()
+        # the producer may be blocked in put(); drain so its timeout loop
+        # observes _stop promptly
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        # release the wrapped stream's resources (native loader handle,
+        # mmap) deterministically, not at GC — only once its thread is gone
+        if not self._thread.is_alive():
+            wrapped_close = getattr(self._it, "close", None)
+            if callable(wrapped_close):
+                try:
+                    wrapped_close()
+                except Exception:
+                    pass
+
+    def __enter__(self) -> "PrefetchIterator[T]":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:  # last-resort cleanup for unclosed streams
+        try:
+            self.close(timeout=1.0)
+        except Exception:
+            pass
+
+
+def close_batches(it) -> None:
+    """Shut down a stream returned by ``make_batches`` if it owns a thread
+    (PrefetchIterator); plain generators are a no-op."""
+    close = getattr(it, "close", None)
+    if callable(close):
+        close()
+
+
+__all__ = ["PrefetchIterator", "close_batches"]

@@ -93,6 +93,21 @@ def state_shardings(
     return TrainState(step=replicated, params=p_shard, opt_state=o_shard)
 
 
+def train_state_avals(
+    cfg: llama.LlamaConfig, optimizer: optax.GradientTransformation,
+) -> TrainState:
+    """Abstract (ShapeDtypeStruct) TrainState matching make_train_state's
+    output — enough to ``step_fn.lower(...)`` before any array exists, so
+    the train-step compile can run concurrently with state init and
+    checkpoint restore (fit()'s compile-ahead path)."""
+    params = jax.eval_shape(partial(llama.init_params, cfg=cfg), jax.random.key(0))
+    return TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        params=params,
+        opt_state=jax.eval_shape(optimizer.init, params),
+    )
+
+
 def make_train_state(
     rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
     optimizer: optax.GradientTransformation, rules: Rules = DEFAULT_RULES,
