@@ -30,6 +30,8 @@ def main() -> None:
     p.add_argument("--checkpoint-dir", default=os.environ.get("TONY_CHECKPOINT_DIR", ""))
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--attention", default="", help="dot | flash | ring")
+    p.add_argument("--ce-impl", default="",
+                   help="loss head: scan (fused, default) | pallas | dense")
     p.add_argument("--prefetch", type=int, default=2,
                    help="device-prefetch depth (0 = synchronous input path)")
     args = p.parse_args()
@@ -56,6 +58,7 @@ def main() -> None:
             log_every=max(args.steps // 10, 1),
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
+            ce_impl=args.ce_impl,
         )
     )
     if jax.process_index() == 0:

@@ -58,6 +58,7 @@ def main() -> None:
     for s in K.JOB_SUFFIXES:
         lines.append(f"| `{s}` | {suffix_doc.get(s, '')} |")
     lines += _data_config_section()
+    lines += _fit_config_section()
     out = os.path.join(os.path.dirname(__file__), "..", "docs", "CONFIG.md")
     with open(out, "w") as f:
         f.write("\n".join(lines) + "\n")
@@ -101,6 +102,54 @@ def _data_config_section() -> list[str]:
             f"| `data.{f.name}` | `{default}` | "
             f"{notes.get(f.name, '').replace('|', chr(92) + '|')} |"
         )
+    return lines
+
+
+def _fit_config_section() -> list[str]:
+    """Document fit()'s trainer knobs (`FitConfig`, scalar fields only —
+    `model`/`data`/`rules`/`mesh_shape` are structured Python values with
+    their own references)."""
+    import dataclasses
+
+    from tony_tpu.train.loop import FitConfig
+
+    notes = {
+        "steps": "optimizer steps to run",
+        "log_every": "metrics log/push cadence (the first step always logs)",
+        "checkpoint_dir": "orbax checkpoint root; empty disables checkpoints",
+        "checkpoint_every": "save cadence in steps (0 = only the final save)",
+        "checkpoint_keep": "checkpoints retained (older ones pruned)",
+        "lr": "peak learning rate (warmup-cosine schedule)",
+        "warmup_steps": "linear warmup steps to peak lr",
+        "pp_microbatches": "pipeline microbatches when mesh_shape.pp > 1 "
+                           "(0 -> 2 per stage)",
+        "pp_schedule": "gpipe (autodiff bwd, O(M) activations) \\| 1f1b "
+                       "(interleaved bwd, O(P) activations)",
+        "resume": "restore from checkpoint_dir when a checkpoint exists",
+        "compile_ahead": "AOT-compile the train step on a worker thread "
+                         "during startup (docs/PERF.md \"Overlap\")",
+        "mu_dtype": "Adam first-moment dtype (float32 \\| bfloat16); bf16 "
+                    "frees 2 bytes/param of HBM",
+        "ce_impl": "loss-head override: empty keeps model.ce_impl; scan / "
+                   "pallas select the fused chunked CE (no [B,S,V] logits "
+                   "transient — docs/PERF.md \"Fused cross-entropy\"), "
+                   "dense the legacy full-logits head. Chunk/tile sizes: "
+                   "`LlamaConfig.ce_vocab_chunk` / `ce_block_n` / "
+                   "`ce_block_v`",
+    }
+    skip = {"model", "data", "rules", "mesh_shape", "on_metrics"}
+    lines = ["", "## Trainer (`FitConfig`, Python API)", "",
+             "Set on `fit(FitConfig(...))` in the training script; these are "
+             "not job-file keys. `model` (LlamaConfig), `data` (DataConfig "
+             "above), `mesh_shape` (MeshShape) and `rules` carry the "
+             "structured configs.", "",
+             "| field | default | notes |", "|---|---|---|"]
+    for f in dataclasses.fields(FitConfig):
+        if f.name in skip:
+            continue
+        default = f.default
+        default = '""' if default == "" else f"{default}"
+        lines.append(f"| `{f.name}` | `{default}` | {notes.get(f.name, '')} |")
     return lines
 
 

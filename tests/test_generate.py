@@ -25,6 +25,26 @@ def test_prefill_matches_forward(setup):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expect), atol=1e-4)
 
 
+def test_prefill_last_only_matches_full_projection(setup):
+    """generate()'s prefill path projects ONLY the last position through
+    lm_head ([B,1,V] instead of [B,S,V] fp32): same sampled logits, same
+    cache, no prompt-sized logits transient."""
+    cfg, params = setup
+    tokens = jax.random.randint(jax.random.key(8), (2, 16), 0, cfg.vocab_size)
+    full, cache_full = forward_with_cache(
+        params, tokens, KVCache.create(cfg, 2, 32), jnp.int32(0), cfg
+    )
+    last, cache_last = forward_with_cache(
+        params, tokens, KVCache.create(cfg, 2, 32), jnp.int32(0), cfg,
+        last_only=True,
+    )
+    assert last.shape == (2, 1, cfg.vocab_size)
+    np.testing.assert_allclose(
+        np.asarray(last[:, 0]), np.asarray(full[:, -1]), atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(cache_last.k), np.asarray(cache_full.k))
+
+
 def test_incremental_decode_matches_forward(setup):
     """Logits from one-token-at-a-time decoding must equal the full forward
     pass at every position — the KV cache is exact, not approximate."""

@@ -1,19 +1,29 @@
-"""Tier-1 overlap guard: the steady-state step loop must stay stall-free.
+"""Tier-1 perf guards: the step loop must stay stall-free and the loss head
+must stay fused.
 
-A data-layer or loop change that re-serializes host input work against the
-device step (dropping the prefetch wrap, adding a blocking sync inside the
-loop, an accidentally-quadratic sampler) shows up here as host-blocked
-wall time. The threshold is deliberately generous — the CPU CI rig shares
-two cores between the "device" step and the producer thread — but a fully
-re-serialized loop (host_blocked_frac ~= host work / step time) clears it
-by an order of magnitude on the failure side.
+Overlap guard: a data-layer or loop change that re-serializes host input
+work against the device step (dropping the prefetch wrap, adding a blocking
+sync inside the loop, an accidentally-quadratic sampler) shows up here as
+host-blocked wall time. The threshold is deliberately generous — the CPU CI
+rig shares two cores between the "device" step and the producer thread —
+but a fully re-serialized loop (host_blocked_frac ~= host work / step time)
+clears it by an order of magnitude on the failure side.
+
+Loss-head memory guard: a head change that re-materialises [B, S, V] logits
+(or lets autodiff build a full dlogits) shows up in the compiled step's
+temp-buffer assignment, measured without running anything.
 """
 
+import dataclasses
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from tony_tpu.models.llama import LlamaConfig
-from tony_tpu.parallel.mesh import MeshShape
+from tony_tpu.parallel.mesh import MeshShape, build_mesh
 from tony_tpu.train import DataConfig, FitConfig, fit
+from tony_tpu.train import trainer
 
 # generous: tolerate CI noise and GIL contention; a reserialized input
 # path on this config measures well above it (see docs/PERF.md "Overlap")
@@ -43,3 +53,37 @@ def test_steady_state_loop_is_not_host_blocked():
     # startup phases are reported (compile-ahead instrumentation)
     assert "compile_s" in final.get("startup", {})
     assert "first_batch_s" in final.get("startup", {})
+
+
+def test_loss_head_stays_fused_in_memory():
+    """Lower + compile the tiny-model train step (vocab scaled up so the
+    loss head dominates) and assert the compiled temp footprint stays below
+    the full-logits bound — one [B, S, V] fp32 tensor. The dense head
+    measures ~3.7x that bound on this config (logits + dlogits + fusion
+    slack), the fused head ~0.9x, so a head regression that re-materialises
+    logits fails with a wide margin while leaving headroom for benign
+    scheduling noise in the rest of the step."""
+    B, S = 8, 128
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), vocab_size=8192, max_seq_len=S, ce_vocab_chunk=512
+    )
+    mesh = build_mesh(MeshShape(dp=1))
+    opt = trainer.default_optimizer(warmup_steps=1, decay_steps=10)
+    state = trainer.make_train_state(jax.random.key(0), cfg, mesh, opt)
+    toks = jax.ShapeDtypeStruct((B, S), jnp.int32)
+
+    def temp_bytes(c):
+        step = trainer.make_train_step(c, mesh, opt)
+        compiled = step.lower(state, toks, toks).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    full_logits = B * S * cfg.vocab_size * 4  # one fp32 [B, S, V]
+    fused = temp_bytes(cfg)  # ce_impl='scan' is the default train path
+    assert fused < full_logits, (
+        f"fused train step temp {fused / 2**20:.1f}MiB >= full-logits bound "
+        f"{full_logits / 2**20:.1f}MiB — the loss head is materialising "
+        "vocab-sized tensors again"
+    )
+    # and the guard itself is meaningful: the dense head blows the bound
+    dense = temp_bytes(dataclasses.replace(cfg, ce_impl="dense"))
+    assert dense > 2 * fused, (fused, dense)

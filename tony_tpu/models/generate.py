@@ -63,11 +63,18 @@ def forward_with_cache(
     cache: KVCache,
     start_pos: jax.Array,
     cfg: LlamaConfig,
+    last_only: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """tokens [B,S] starting at absolute position start_pos (traced scalar).
 
     Returns (logits [B,S,vocab] f32, updated cache). Used for both prefill
     (S = prompt length) and decode (S = 1) — same trace, two compiles.
+
+    ``last_only`` (static) projects only the final position through
+    ``lm_head``, returning logits [B,1,vocab]: prefill needs exactly the
+    last position to sample from, and the full projection would build a
+    [B,S,V] fp32 tensor (at 7B shapes, ~0.5GB for a 2k prompt) just to
+    discard all but one row.
     """
     B, S = tokens.shape
     x = params["tok_emb"][tokens]
@@ -96,6 +103,8 @@ def forward_with_cache(
 
     x, (new_k, new_v) = lax.scan(block, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if last_only:
+        x = x[:, -1:]
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, KVCache(new_k, new_v)
 
@@ -126,7 +135,9 @@ def generate(
     if rng is None:
         rng = jax.random.key(0)
 
-    prefill = jax.jit(partial(forward_with_cache, cfg=cfg))
+    # prefill projects only the last position through lm_head (the rest of
+    # the prompt's logits would be discarded by the [:, -1] below anyway)
+    prefill = jax.jit(partial(forward_with_cache, cfg=cfg, last_only=True))
     logits, cache = prefill(params, prompt, cache, jnp.int32(0))
     next_rng, rng = jax.random.split(rng)
     last = _sample(logits[:, -1], temperature, top_k, top_p, next_rng)
@@ -136,7 +147,9 @@ def generate(
 
     def step(carry, rng_step):
         cache, tok, pos, done = carry
-        logits, cache = forward_with_cache(params, tok[:, None], cache, pos, cfg)
+        logits, cache = forward_with_cache(
+            params, tok[:, None], cache, pos, cfg, last_only=True
+        )
         nxt = _sample(logits[:, -1], temperature, top_k, top_p, rng_step)
         if eos_id is not None:
             nxt = jnp.where(done, jnp.int32(eos_id), nxt)

@@ -70,6 +70,17 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_dispatch: str = "gather"  # gather | einsum (see parallel.moe)
     moe_aux_coef: float = 0.01
+    # loss head (tony_tpu.ops.fused_ce): 'scan' = fused chunked CE via
+    # lax.scan (default — never materialises [B,S,V] logits, runs anywhere);
+    # 'pallas' = fused TPU kernel (VMEM accumulators over the vocab grid);
+    # 'dense' = legacy full-logits logsumexp reference.
+    ce_impl: str = "scan"
+    # vocab columns per chunk for ce_impl='scan' (the forward/backward
+    # transient is one [B*S, ce_vocab_chunk] fp32 block)
+    ce_vocab_chunk: int = 4096
+    # pallas CE kernel tile sizes (rows x vocab); clipped to B*S and V
+    ce_block_n: int = 512
+    ce_block_v: int = 512
 
     @property
     def head_dim(self) -> int:
@@ -417,10 +428,14 @@ def _remat_policy(name: str):
     return policies[name]
 
 
-def forward_with_aux(
+def hidden_states_with_aux(
     params: Params, tokens: jax.Array, cfg: LlamaConfig
 ) -> tuple[jax.Array, jax.Array]:
-    """tokens [B, S] int32 -> (logits [B, S, vocab] float32, aux_loss)."""
+    """tokens [B, S] int32 -> (post-final-norm hidden [B, S, D], aux_loss).
+
+    The trunk without the vocab projection: the fused CE head consumes this
+    directly so the [B, S, V] logits tensor never exists on the train path.
+    """
     x = params["tok_emb"][tokens]
     cos, sin = rope_table(cfg, tokens.shape[1])
 
@@ -435,13 +450,38 @@ def forward_with_aux(
         block, (x, jnp.zeros((), jnp.float32)), params["layers"],
         unroll=cfg.scan_unroll,
     )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32), aux / cfg.n_layers
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux / cfg.n_layers
+
+
+def forward_with_aux(
+    params: Params, tokens: jax.Array, cfg: LlamaConfig
+) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, S] int32 -> (logits [B, S, vocab] float32, aux_loss)."""
+    x, aux = hidden_states_with_aux(params, tokens, cfg)
+    return (x @ params["lm_head"]).astype(jnp.float32), aux
 
 
 def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
     return forward_with_aux(params, tokens, cfg)[0]
+
+
+def ce_tokens(
+    h: jax.Array, lm_head: jax.Array, targets: jax.Array, cfg: LlamaConfig
+) -> jax.Array:
+    """Per-token CE [B, S] f32 from post-norm hidden states, dispatched on
+    ``cfg.ce_impl``. The ONE head every loss path shares (sequential, GPipe,
+    1F1B), so schedule-parity tests compare identical math."""
+    if cfg.ce_impl == "dense":
+        # legacy full-logits path (the fused impls' parity oracle — ONE copy
+        # of the math, in ops.fused_ce): the logits and autodiff's dlogits
+        # still materialise at [B,S,V]
+        from tony_tpu.ops.fused_ce import reference_ce_tokens
+
+        return reference_ce_tokens(h, lm_head, targets)
+    from tony_tpu.ops.fused_ce import sharded_fused_ce_tokens
+
+    return sharded_fused_ce_tokens(h, lm_head, targets, cfg)
 
 
 def loss_from_pairs(
@@ -451,14 +491,11 @@ def loss_from_pairs(
 
     Pre-shifted pairs keep the sequence length identical across inputs,
     activations, and targets, so a ``sp``-sharded seq axis stays aligned end
-    to end (no off-by-one reshard between forward and loss).
+    to end (no off-by-one reshard between forward and loss). The head runs
+    through :func:`ce_tokens` (fused chunked CE by default).
     """
-    logits, aux = forward_with_aux(params, inputs, cfg)
-    # logsumexp - target_logit == -log_softmax[target], without materialising
-    # the full [B,S,V] log-prob tensor (half the HBM traffic of the loss).
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    ce = jnp.mean(lse - tgt)
+    h, aux = hidden_states_with_aux(params, inputs, cfg)
+    ce = jnp.mean(ce_tokens(h, params["lm_head"], targets, cfg))
     if cfg.is_moe:
         ce = ce + cfg.moe_aux_coef * aux
     return ce
@@ -478,7 +515,8 @@ def train_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
 
 __all__ = [
     "LlamaConfig", "init_params", "logical_axes", "forward",
-    "forward_with_aux", "loss_fn", "loss_from_pairs",
+    "forward_with_aux", "hidden_states_with_aux", "ce_tokens",
+    "loss_fn", "loss_from_pairs",
     "rms_norm", "rope_table", "apply_rope", "dot_attention",
     "transformer_block", "train_flops_per_token",
 ]

@@ -1,5 +1,6 @@
 """TPU kernels (Pallas) with interpreter-mode CPU fallbacks."""
 
 from tony_tpu.ops.attention import flash_attention
+from tony_tpu.ops.fused_ce import fused_ce_tokens
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fused_ce_tokens"]

@@ -92,7 +92,12 @@ def inside_manual_region() -> bool:
     ``jax.sharding.use_mesh`` context also sets one, with Auto/Explicit axis
     types — only Manual axes mean an enclosing shard_map region that shardy
     forbids re-binding collective axes inside."""
-    from jax.sharding import AxisType, get_abstract_mesh
+    try:
+        from jax.sharding import AxisType, get_abstract_mesh
+    except ImportError:
+        # jax builds without abstract-mesh typing predate the manual-region
+        # pipeline paths entirely, so there is no region to detect
+        return False
 
     mesh = get_abstract_mesh()
     if mesh is None or not mesh.shape_tuple:

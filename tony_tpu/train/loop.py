@@ -83,6 +83,10 @@ class FitConfig:
     # Adam first-moment dtype ('float32' | 'bfloat16'); bf16 frees
     # 2 bytes/param of HBM (see default_optimizer / docs/PERF.md)
     mu_dtype: str = "float32"
+    # loss-head implementation override: '' keeps model.ce_impl; 'scan' /
+    # 'pallas' select the fused chunked CE (tony_tpu.ops.fused_ce — no
+    # [B,S,V] logits transient), 'dense' the legacy full-logits head
+    ce_impl: str = ""
 
     def apply_job_env(self) -> None:
         """Fill unset checkpoint fields from the TONY_CHECKPOINT_* env the
@@ -123,6 +127,10 @@ def _start_async_host_copy(metrics: dict) -> None:
 def _fit(cfg: FitConfig) -> dict:
     jax_tpu.initialize()  # no-op outside a tony-tpu job
     cfg.apply_job_env()
+    if cfg.ce_impl:
+        from dataclasses import replace as _replace
+
+        cfg.model = _replace(cfg.model, ce_impl=cfg.ce_impl)
     cache_dir = os.environ.get("TONY_JAX_CACHE_DIR", "")
     if cache_dir:
         # persistent XLA compilation cache (train.jax_cache, default on):

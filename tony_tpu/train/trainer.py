@@ -239,13 +239,12 @@ def _pp_guard(cfg: llama.LlamaConfig, mesh: Mesh) -> None:
 def _ce_head(final_norm: jax.Array, lm_head: jax.Array, h: jax.Array,
              targets: jax.Array, cfg: llama.LlamaConfig) -> jax.Array:
     """final norm + lm head + mean cross-entropy — the ONE copy both
-    pipeline schedules share (llama.loss_from_pairs keeps the model-level
-    equivalent so the model stays importable without the trainer)."""
+    pipeline schedules share. Routes through llama.ce_tokens, so the fused
+    chunked CE (ce_impl scan/pallas — no [mb, S, V] logits or dlogits per
+    microbatch) and the dense reference stay interchangeable here exactly
+    as in the sequential loss."""
     h = llama.rms_norm(h, final_norm, cfg.norm_eps)
-    logits = (h @ lm_head).astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    sel = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - sel)
+    return jnp.mean(llama.ce_tokens(h, lm_head, targets, cfg))
 
 
 def _pp_stage_fn(cfg: llama.LlamaConfig, cos: jax.Array, sin: jax.Array):
