@@ -304,6 +304,100 @@ def flash_matches_dot_on_tpu() -> bool:
     return True
 
 
+def moe_routing_stats(cfg) -> dict:
+    """Router health at bench shapes: run the (initialised) router over a
+    random activation batch and report what the capacity semantics would
+    drop vs what dropless serves (parallel.moe.routing_stats)."""
+    from tony_tpu.parallel.moe import MoEConfig, init_moe_params, routing_stats
+
+    mcfg = MoEConfig(
+        dim=cfg.dim, ffn_dim=cfg.ffn_dim, n_experts=cfg.n_experts,
+        top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+    )
+    params = init_moe_params(jax.random.key(5), mcfg)
+    x = jax.random.normal(jax.random.key(6), (8 * 2048, cfg.dim), jnp.float32)
+    probs = jax.nn.softmax(x @ params["router"], axis=-1)
+    return routing_stats(probs, mcfg)
+
+
+def moe_bench(steps: int = 10) -> dict:
+    """MoE train step per dispatch impl: grouped (dropless sorted grouped
+    GEMM, scan + pallas kernels) vs the round-4 gather baseline vs the
+    einsum reference, plus routing stats (dropped-route fraction, expert
+    load imbalance) so the dropless gains are legible in the trajectory.
+
+    4 experts (~1.2B total / ~700M active): the 8-expert preset's AdamW
+    state alone exceeds the chip's 16GB. Capacity factor 1.0 for the
+    capacity paths (round-4 tuning, docs/PERF.md); irrelevant to grouped.
+    HBM: per-dispatch peaks are not reported — the device counter is a
+    cumulative process high-water mark (_peak_hbm) dominated by the earlier
+    dense bench; one labeled cumulative snapshot rides `cum_peak_after_moe`
+    instead."""
+    from tony_tpu.models.llama import LlamaConfig
+
+    def cfg_for(**kw):
+        return LlamaConfig.bench_moe(
+            n_experts=4, attention_impl="flash",
+            remat_policy="save_attn_kernel", moe_capacity_factor=1.0, **kw,
+        )
+
+    per_dispatch = {}
+    for name, kw in (
+        ("grouped", {"moe_dispatch": "grouped"}),
+        ("grouped_pallas", {"moe_dispatch": "grouped", "moe_gmm_impl": "pallas"}),
+        ("gather", {"moe_dispatch": "gather"}),
+        ("einsum", {"moe_dispatch": "einsum"}),
+    ):
+        try:
+            r = train_bench(
+                cfg_for(**kw), batch=8, seq=2048, steps=steps,
+                mu_dtype=jnp.bfloat16,
+            )
+            # no per-dispatch peak keys: peak_bytes_in_use is a cumulative
+            # process high-water mark (_peak_hbm) already dominated by the
+            # earlier dense 1.35B bench, so attributing it to any one MoE
+            # config would be a lie — one labeled cumulative number below
+            per_dispatch[name] = {
+                k: r[k]
+                for k in ("tokens_per_sec_per_chip", "mfu", "loss")
+                if k in r
+            }
+        except Exception as e:
+            per_dispatch[name] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+    headline_cfg = cfg_for(moe_dispatch="grouped")
+    # headline = first dispatch that actually produced numbers; when every
+    # run failed, say so instead of wearing a working dispatch's name (the
+    # per-run errors stay visible in per_dispatch)
+    headline_name = next(
+        (n for n in ("grouped", "gather")
+         if "tokens_per_sec_per_chip" in per_dispatch.get(n, {})),
+        None,
+    )
+    out = {
+        "n_params": headline_cfg.n_params,
+        "n_active_params": headline_cfg.n_active_params,
+        "dispatch": headline_name or "all_failed",
+        "capacity_factor": 1.0,
+        "batch": 8,
+        "seq": 2048,
+        **(per_dispatch.get(headline_name, {}) if headline_name else {}),
+        "per_dispatch": per_dispatch,
+        # process high-water mark AFTER all MoE configs ran — includes the
+        # earlier dense benches (cumulative, see _peak_hbm), hence the name
+        "cum_peak_after_moe": _peak_hbm(),
+    }
+    g = per_dispatch.get("grouped", {}).get("tokens_per_sec_per_chip", 0)
+    b = per_dispatch.get("gather", {}).get("tokens_per_sec_per_chip", 0)
+    if g and b:
+        out["grouped_vs_gather"] = round(g / b, 3)
+    try:
+        out["routing"] = moe_routing_stats(headline_cfg)
+    except Exception as e:
+        out["routing"] = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+    return out
+
+
 def overlap_bench(cfg, batch: int, seq: int, steps: int, mu_dtype: str) -> dict:
     """fit()-driven input-pipeline benchmark. train_bench() feeds a
     pre-staged device batch (no input pipeline at all); this runs the REAL
@@ -463,23 +557,7 @@ def run_bench() -> dict:
     extra["gqa_kernel_32_8"] = gqa_kernel_bench()
     extra["flash_s32768"] = long_context_bench()
     try:
-        # 4 experts (~1.2B total / ~700M active): the 8-expert preset's
-        # AdamW state alone exceeds the chip's 16GB. Round-4 tuning
-        # (docs/PERF.md MoE section): gather/scatter dispatch (zero routing
-        # matmul FLOPs vs the one-hot einsums' ~2x-the-expert-FFN cost),
-        # capacity factor 1.0, batch 8 — 22.1% -> 37.1% MFU measured.
-        moe_cfg = LlamaConfig.bench_moe(
-            n_experts=4, attention_impl="flash", remat_policy="save_attn_kernel",
-            moe_capacity_factor=1.0,
-        )
-        moe = train_bench(moe_cfg, batch=8, seq=2048, steps=10, mu_dtype=jnp.bfloat16)
-        extra["moe_top2"] = {
-            "n_params": moe_cfg.n_params,
-            "n_active_params": moe_cfg.n_active_params,
-            "dispatch": moe_cfg.moe_dispatch,
-            "capacity_factor": 1.0,
-            **moe,
-        }
+        extra["moe_top2"] = moe_bench()
     except Exception as e:
         extra["moe_top2"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
     try:

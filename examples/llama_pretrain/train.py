@@ -32,6 +32,8 @@ def main() -> None:
     p.add_argument("--attention", default="", help="dot | flash | ring")
     p.add_argument("--ce-impl", default="",
                    help="loss head: scan (fused, default) | pallas | dense")
+    p.add_argument("--moe-dispatch", default="",
+                   help="MoE dispatch: grouped (dropless) | gather | einsum")
     p.add_argument("--prefetch", type=int, default=2,
                    help="device-prefetch depth (0 = synchronous input path)")
     args = p.parse_args()
@@ -59,6 +61,7 @@ def main() -> None:
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             ce_impl=args.ce_impl,
+            moe_dispatch=args.moe_dispatch,
         )
     )
     if jax.process_index() == 0:

@@ -1,5 +1,6 @@
 """Generate docs/CONFIG.md from the config key registry (single source of
-truth: tony_tpu/config/keys.py). Re-run after adding keys."""
+truth: tony_tpu/config/keys.py). Re-run after adding keys, or run with
+``--check`` (CI / tier-1) to exit nonzero when docs/CONFIG.md is stale."""
 
 import inspect
 import os
@@ -10,8 +11,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from tony_tpu.config import keys as K  # noqa: E402
 
+OUT = os.path.join(os.path.dirname(__file__), "..", "docs", "CONFIG.md")
 
-def main() -> None:
+
+def build() -> str:
     src = inspect.getsource(K.Keys)
     lines = ["# Configuration reference", "",
              "Generated from `tony_tpu/config/keys.py` by "
@@ -59,10 +62,30 @@ def main() -> None:
         lines.append(f"| `{s}` | {suffix_doc.get(s, '')} |")
     lines += _data_config_section()
     lines += _fit_config_section()
-    out = os.path.join(os.path.dirname(__file__), "..", "docs", "CONFIG.md")
-    with open(out, "w") as f:
-        f.write("\n".join(lines) + "\n")
-    print(f"wrote {os.path.abspath(out)} ({len(lines)} lines)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str]) -> int:
+    content = build()
+    if "--check" in argv:
+        try:
+            with open(OUT) as f:
+                current = f.read()
+        except FileNotFoundError:
+            current = ""
+        if current != content:
+            print(
+                f"{os.path.abspath(OUT)} is stale — rerun "
+                "scripts/gen_config_doc.py",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"{os.path.abspath(OUT)} is up to date")
+        return 0
+    with open(OUT, "w") as f:
+        f.write(content)
+    print(f"wrote {os.path.abspath(OUT)} ({content.count(chr(10))} lines)")
+    return 0
 
 
 def _data_config_section() -> list[str]:
@@ -136,6 +159,15 @@ def _fit_config_section() -> list[str]:
                    "dense the legacy full-logits head. Chunk/tile sizes: "
                    "`LlamaConfig.ce_vocab_chunk` / `ce_block_n` / "
                    "`ce_block_v`",
+        "moe_dispatch": "MoE dispatch override: empty keeps "
+                        "model.moe_dispatch; grouped selects the dropless "
+                        "sorted grouped GEMM (no capacity slots, no dropped "
+                        "tokens — docs/PERF.md \"Grouped MoE\"), gather / "
+                        "einsum the fixed-capacity paths. Kernel choice: "
+                        "`LlamaConfig.moe_gmm_impl` (scan \\| pallas)",
+        "moe_group_block": "grouped-GEMM row tile override (0 keeps "
+                           "`model.moe_group_block`); each expert's ragged "
+                           "token group pads up to a multiple of this",
     }
     skip = {"model", "data", "rules", "mesh_shape", "on_metrics"}
     lines = ["", "## Trainer (`FitConfig`, Python API)", "",
@@ -154,4 +186,4 @@ def _fit_config_section() -> list[str]:
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(sys.argv[1:]))

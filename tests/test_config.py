@@ -202,3 +202,21 @@ def test_no_dead_config_keys():
         )
     ]
     assert dead == [], f"config keys defined but consumed nowhere: {dead}"
+
+
+def test_config_doc_is_not_stale():
+    """docs/CONFIG.md is generated (scripts/gen_config_doc.py); a knob added
+    to DataConfig/FitConfig/keys.py without regenerating the doc fails here
+    — run `python scripts/gen_config_doc.py` to fix. The subprocess runs the
+    script's --check mode exactly as CI would."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "gen_config_doc.py"),
+         "--check"],
+        capture_output=True, text=True, env=env, cwd=repo,
+    )
+    assert out.returncode == 0, f"stale docs/CONFIG.md:\n{out.stderr}{out.stdout}"

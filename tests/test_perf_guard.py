@@ -87,3 +87,39 @@ def test_loss_head_stays_fused_in_memory():
     # and the guard itself is meaningful: the dense head blows the bound
     dense = temp_bytes(dataclasses.replace(cfg, ce_impl="dense"))
     assert dense > 2 * fused, (fused, dense)
+
+
+def test_grouped_moe_dispatch_stays_below_einsum_tensors():
+    """Lower + compile a grad of the MoE block at a shape where the one-hot
+    dispatch/combine tensors dominate, and assert the grouped (dropless)
+    path's compiled temp footprint stays below what the einsum dispatch
+    materialises for routing alone — two [T, E, C] fp32 tensors. A grouped-
+    path regression that re-materialises capacity-slot tensors (or lets the
+    sort blow up into per-expert one-hots) fails this without running a
+    step; the einsum path itself exceeds the bound, proving it's tight."""
+    import jax.numpy as jnp
+
+    from tony_tpu.parallel.moe import MoEConfig, init_moe_params, moe_block
+
+    T, D = 4096, 128
+    base = MoEConfig(dim=D, ffn_dim=2 * D, n_experts=8, top_k=2)
+    params = init_moe_params(jax.random.key(0), base, dtype=jnp.float32)
+    x = jax.ShapeDtypeStruct((1, T, D), jnp.float32)
+
+    def temp_bytes(cfg):
+        def loss(p, xx):
+            y, aux = moe_block(p, xx, cfg)
+            return jnp.sum(y * y) + aux
+
+        compiled = jax.jit(jax.value_and_grad(loss)).lower(params, x).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    dispatch_tensors = 2 * T * base.n_experts * base.capacity(T) * 4
+    grouped = temp_bytes(dataclasses.replace(base, dispatch="grouped"))
+    assert grouped < dispatch_tensors, (
+        f"grouped MoE temp {grouped / 2**20:.1f}MiB >= einsum dispatch-tensor "
+        f"bound {dispatch_tensors / 2**20:.1f}MiB — the dropless path is "
+        "materialising capacity-sized routing tensors again"
+    )
+    einsum = temp_bytes(dataclasses.replace(base, dispatch="einsum"))
+    assert einsum > dispatch_tensors, (grouped, einsum, dispatch_tensors)

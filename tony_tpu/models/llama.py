@@ -68,7 +68,16 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
-    moe_dispatch: str = "gather"  # gather | einsum (see parallel.moe)
+    # 'gather' / 'einsum' (fixed-capacity slots, overflow tokens dropped) |
+    # 'grouped' (dropless sorted grouped GEMM — no capacity, no drops; see
+    # parallel.moe and docs/PERF.md "Grouped MoE")
+    moe_dispatch: str = "gather"
+    # moe_dispatch='grouped': row-tile of the grouped GEMM (each expert's
+    # ragged token group pads up to a multiple of this)
+    moe_group_block: int = 128
+    # moe_dispatch='grouped': 'scan' (pure-XLA, runs anywhere — default) |
+    # 'pallas' (TPU kernel, tony_tpu.ops.grouped_mm)
+    moe_gmm_impl: str = "scan"
     moe_aux_coef: float = 0.01
     # loss head (tony_tpu.ops.fused_ce): 'scan' = fused chunked CE via
     # lax.scan (default — never materialises [B,S,V] logits, runs anywhere);
@@ -371,7 +380,8 @@ def moe_ffn_block(x: jax.Array, lp: Params, cfg: LlamaConfig):
     mcfg = MoEConfig(
         dim=cfg.dim, ffn_dim=cfg.ffn_dim, n_experts=cfg.n_experts,
         top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
-        dispatch=cfg.moe_dispatch,
+        dispatch=cfg.moe_dispatch, group_block=cfg.moe_group_block,
+        gmm_impl=cfg.moe_gmm_impl,
     )
     return moe_block(
         {"router": lp["router"], "w1": lp["w1"], "w3": lp["w3"], "w2": lp["w2"]},

@@ -29,11 +29,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tony_tpu.ops.compat import (
+    pallas_compiler_params as _CompilerParams,
+    shard_map_compat as _shard_map,
+    use_interpret as _use_interpret,
+)
+
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
-
-
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 # --- forward -----------------------------------------------------------------
@@ -90,13 +92,11 @@ def _kv_index(b: int, heads: int, kv_heads: int) -> int:
 def _out_struct(shape, dtype, *inputs) -> jax.ShapeDtypeStruct:
     """Pallas out_shape carrying the inputs' varying-mesh-axes type: inside a
     shard_map region (e.g. a pp pipeline stage) outputs must declare the vma
-    set or shard_map's type checker rejects the call."""
-    vma = frozenset()
-    for x in inputs:
-        vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
+    set or shard_map's type checker rejects the call. One shared copy in
+    ops.compat (degrades gracefully on jax builds without ``jax.typeof``)."""
+    from tony_tpu.ops.compat import struct_with_vma
+
+    return struct_with_vma(shape, dtype, *inputs)
 
 
 def _flash_fwd(q, k, v, *, scale, blk_q, blk_k, causal, heads, kv_heads):
@@ -119,7 +119,7 @@ def _flash_fwd(q, k, v, *, scale, blk_q, blk_k, causal, heads, kv_heads):
             _out_struct((BH, 1, S), jnp.float32, q, k, v),
         ],
         # out/lse blocks revisit the same index across the k-step dim
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         scratch_shapes=[
@@ -241,7 +241,7 @@ def flash_dq_pass(q, k, v, do, lse, delta, *, scale, blk_q, blk_k, causal,
         out_specs=[qspec],
         out_shape=[_out_struct((BH, S, D), q.dtype, q, k, v, do)],
         scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_use_interpret(),
@@ -282,7 +282,7 @@ def flash_dkv_pass(q, k, v, do, lse, delta, *, scale, blk_q, blk_k, causal,
             pltpu.VMEM((blk_k, D), jnp.float32),
             pltpu.VMEM((blk_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_use_interpret(),
@@ -408,7 +408,7 @@ def sharded_flash_attention(q, k, v, cfg=None, **kwargs) -> jax.Array:
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     spec = attn_spec(mesh)  # seq_axis=None: sequence stays device-local
-    return jax.shard_map(
+    return _shard_map(
         lambda a, b, c: flash_attention(a, b, c, cfg, **kwargs),
         mesh=mesh,
         in_specs=(spec, spec, spec),

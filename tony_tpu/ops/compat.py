@@ -1,0 +1,66 @@
+"""jax-version compatibility shims shared by the Pallas kernel modules.
+
+One copy of the glue that differs across the jax lines this repo runs on
+(the CI image's 0.4.x vs newer): the TPU compiler-params spelling, the
+vma-carrying ShapeDtypeStruct for kernels under shard_map, interpret-mode
+selection off-TPU, and the shard_map entry itself. Kernel modules
+(fused_ce, grouped_mm) and their callers import from here so a version fix
+lands once.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+# jax < 0.5 spells these differently; resolve once so the kernels (and the
+# CPU interpreter tests) run on either line
+pallas_compiler_params = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
+
+def struct_with_vma(shape, dtype, *inputs) -> jax.ShapeDtypeStruct:
+    """Pallas out_shape carrying the inputs' varying-mesh-axes type (see
+    ops/attention._out_struct); degrades to a plain struct on jax builds
+    without ``jax.typeof``/vma typing."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    vma = frozenset()
+    for x in inputs:
+        vma |= getattr(typeof(x), "vma", frozenset()) or frozenset()
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def shard_map_compat(*args, **kwargs):
+    """``jax.shard_map`` where it exists, the experimental spelling
+    otherwise — translating the new kwargs the old one doesn't know:
+    ``check_vma`` -> ``check_rep`` (default off — the legacy checker has no
+    rule for pallas_call; the new-jax path carries the vma set on the
+    kernel out_shape instead) and partial-manual ``axis_names`` -> its
+    complement ``auto``."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        kwargs.setdefault("check_rep", False)
+        if "axis_names" in kwargs:
+            manual = frozenset(kwargs.pop("axis_names"))
+            kwargs["auto"] = frozenset(kwargs["mesh"].axis_names) - manual
+    return fn(*args, **kwargs)
+
+
+def use_interpret() -> bool:
+    """Pallas interpret mode everywhere but real TPU (CPU tests/CI)."""
+    return jax.default_backend() != "tpu"
+
+
+__all__ = [
+    "pallas_compiler_params", "shard_map_compat", "struct_with_vma",
+    "use_interpret",
+]

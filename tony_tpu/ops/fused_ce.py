@@ -48,48 +48,19 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
-
-# jax < 0.5 spells these differently; resolve once so the kernels (and the
-# CPU interpreter tests) run on either line
-_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
-    pltpu, "TPUCompilerParams"
+from tony_tpu.ops.compat import (
+    pallas_compiler_params as _CompilerParams,
+    shard_map_compat as _shard_map,
+    struct_with_vma as _struct,
+    use_interpret as _use_interpret,
 )
 
-
-def _struct(shape, dtype, *inputs) -> jax.ShapeDtypeStruct:
-    """Pallas out_shape carrying the inputs' varying-mesh-axes type (see
-    ops/attention._out_struct); degrades to a plain struct on jax builds
-    without ``jax.typeof``/vma typing."""
-    typeof = getattr(jax, "typeof", None)
-    if typeof is None:
-        return jax.ShapeDtypeStruct(shape, dtype)
-    vma = frozenset()
-    for x in inputs:
-        vma |= getattr(typeof(x), "vma", frozenset()) or frozenset()
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
-
-
-def _shard_map(*args, **kwargs):
-    fn = getattr(jax, "shard_map", None)
-    if fn is None:
-        from jax.experimental.shard_map import shard_map as fn
-
-        # the legacy replication checker has no rule for pallas_call; the
-        # new-jax path carries the vma set on the kernel out_shape instead
-        kwargs.setdefault("check_rep", False)
-    return fn(*args, **kwargs)
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 # pallas tile defaults (clipped to the actual shapes); 512x512 keeps the
 # fp32 accumulators + one W block + one h block well under VMEM at D=2048
 _BLOCK_N = 512
 _BLOCK_V = 512
-
-
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 # --- scan (XLA) implementation ------------------------------------------------

@@ -1,21 +1,35 @@
 """Mixture-of-Experts with expert parallelism.
 
 Absent from the reference (SURVEY.md section 2 parallelism table: EP "—").
-TPU-native formulation (GShard/Switch style, arXiv:2006.16668): routing is
-expressed as dense one-hot dispatch/combine einsums — MXU-friendly, static
-shapes (fixed expert capacity, overflow tokens dropped) — and the expert dim
-is a logical axis ("expert") that the sharding rules map onto a mesh axis.
-With expert weights sharded over that axis, XLA lowers the dispatch/combine
-einsums into the all-to-all exchange that dedicated EP backends hand-write.
+Three interchangeable dispatch implementations behind ``MoEConfig.dispatch``:
 
-All routing statistics are float32; expert FFN compute follows the input
-dtype (bf16 on TPU).
+- ``'einsum'`` — GShard/Switch (arXiv:2006.16668) dense one-hot
+  dispatch/combine einsums over fixed ``[E, C]`` capacity slots. MXU-friendly
+  and the parity reference, but the routing einsums cost ~2x the expert FFN
+  at bench shapes and overflow tokens are dropped.
+- ``'gather'`` — scatter/gather into the same capacity slots: zero routing
+  matmul FLOPs, identical drop semantics (docs/PERF.md round 4).
+- ``'grouped'`` — dropless sorted grouped GEMM (MegaBlocks,
+  arXiv:2211.15841): routes are sorted by expert into ragged contiguous
+  groups and the expert FFN runs as a grouped matmul over block-aligned row
+  tiles (tony_tpu.ops.grouped_mm — a lax.scan fallback anywhere, a Pallas
+  kernel on TPU via ``gmm_impl``). No capacity: nothing padded beyond one
+  row tile per expert, nothing dropped.
+
+All routing statistics (softmax, gates, aux loss) are float32 regardless of
+activation dtype; expert FFN compute follows the input dtype (bf16 on TPU).
+The expert dim is a logical axis ("expert") the sharding rules map onto the
+mesh's ``ep`` axis; the grouped path additionally ships an explicit
+shard_map-over-ep formulation (each shard runs the grouped FFN for its local
+experts only and the combine is a psum) used automatically when a default
+mesh with ``ep > 1`` is registered.
 """
 
 from __future__ import annotations
 
 import math
 from dataclasses import dataclass
+from functools import partial
 from typing import Any
 
 import jax
@@ -29,21 +43,31 @@ class MoEConfig:
     n_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
-    # 'gather' (scatter/gather dispatch, O(T*D) data movement) or 'einsum'
-    # (dense one-hot dispatch, O(T*E*C*D) matmul FLOPs — at bench shapes
-    # those einsums cost ~2x the expert FFN itself; kept as the reference
-    # implementation the gather path is parity-tested against). Measured
-    # single-chip: gather is +51% tokens/s (docs/PERF.md). On large ep
-    # meshes the einsum path's all-to-all lowering may reshard better than
-    # the gather's all-gather — both stay selectable per config.
+    # 'gather' (scatter/gather capacity dispatch, O(T*D) data movement),
+    # 'einsum' (dense one-hot dispatch, O(T*E*C*D) matmul FLOPs — the
+    # reference implementation the others are parity-tested against), or
+    # 'grouped' (dropless sorted grouped GEMM — no capacity slots at all;
+    # the recommended path once its bench gate holds, docs/PERF.md).
     dispatch: str = "gather"
+    # dispatch='grouped': row-tile size of the grouped GEMM; each expert's
+    # ragged group is padded up to a multiple of this (keep it a multiple
+    # of 16 so bf16 sublane tiling is happy on TPU)
+    group_block: int = 128
+    # dispatch='grouped': 'scan' (pure-XLA lax.scan over row tiles — CPU,
+    # shard_map and ep-mesh safe, the default) | 'pallas' (TPU kernel with
+    # scalar-prefetched tile->expert map; interpret mode on CPU)
+    gmm_impl: str = "scan"
 
     def capacity(self, n_tokens: int) -> int:
-        """Per-expert token slots; static given the (padded) token count."""
-        return max(
+        """Per-expert token slots; static given the (padded) token count.
+
+        Rounded up to a multiple of 8 so the [E, C, D] dispatch buffers tile
+        cleanly on the TPU sublane dim (fp32 min tile is 8 rows)."""
+        cap = max(
             1,
             int(math.ceil(self.capacity_factor * self.top_k * n_tokens / self.n_experts)),
         )
+        return -(-cap // 8) * 8
 
 
 def logical_axes() -> dict[str, tuple[str | None, ...]]:
@@ -71,6 +95,55 @@ def init_moe_params(rng: jax.Array, cfg: MoEConfig, dtype=jnp.bfloat16) -> dict[
     }
 
 
+def _top_k_select(probs: jax.Array, cfg: MoEConfig):
+    """One vectorized top-k routing pass shared by every dispatch impl.
+
+    probs: [T, E]. Returns ``(experts [T, k] int32, gates [T, k] f32,
+    pos [T, k] int32, aux f32 scalar)`` — each token's chosen experts, their
+    router probabilities, and the token's position in each chosen expert's
+    queue. Selection and position semantics are identical to the k-round
+    argmax-and-mask loop this replaces: ``lax.top_k`` breaks ties toward the
+    lower expert index (as repeated argmax did), and queue positions are
+    assigned in round-major order (every token's round-0 pick queues before
+    any round-1 pick) via a single cumsum over the [k*T, E] route sequence.
+    All statistics are float32 regardless of the input dtype.
+    """
+    T, E = probs.shape
+    k = cfg.top_k
+    p32 = probs.astype(jnp.float32)
+    gates, sel = jax.lax.top_k(p32, k)                        # [T, k] each
+    sel = sel.astype(jnp.int32)
+    onehot = jax.nn.one_hot(sel, E, dtype=jnp.float32)        # [T, k, E]
+    rm = jnp.swapaxes(onehot, 0, 1).reshape(k * T, E)         # round-major
+    pos_rm = jnp.cumsum(rm, axis=0) - rm                      # [k*T, E]
+    pos = jnp.sum(
+        jnp.swapaxes(pos_rm.reshape(k, T, E), 0, 1) * onehot, axis=-1
+    ).astype(jnp.int32)                                       # [T, k]
+    # load-balancing aux loss (Switch eq. 4): E * sum(frac_routed * mean_prob)
+    importance = jnp.sum(jnp.mean(onehot, axis=0), axis=0)    # [E]
+    aux = cfg.n_experts * jnp.sum(importance / k * jnp.mean(p32, axis=0))
+    return sel, gates, pos, aux
+
+
+def routing_stats(probs: jax.Array, cfg: MoEConfig) -> dict[str, float]:
+    """Routing health under the *capacity* semantics: the route fraction the
+    fixed [E, C] slots would drop, and the expert load imbalance (max/mean
+    assigned routes). The grouped dispatch drops nothing — these numbers
+    quantify exactly what dropless recovers."""
+    T = probs.shape[0]
+    sel, _, pos, _ = _top_k_select(probs, cfg)
+    cap = cfg.capacity(T)
+    kept = jnp.mean((pos < cap).astype(jnp.float32))
+    counts = jnp.bincount(sel.reshape(-1), length=cfg.n_experts)
+    imb = counts.max() / jnp.maximum(jnp.mean(counts.astype(jnp.float32)), 1.0)
+    return {
+        "dropped_frac": round(float(1.0 - kept), 4),
+        "load_imbalance": round(float(imb), 3),
+        "capacity": int(cap),
+        "capacity_factor": cfg.capacity_factor,
+    }
+
+
 def _top_k_dispatch(probs: jax.Array, cfg: MoEConfig, capacity: int):
     """Build dispatch/combine tensors from router probabilities.
 
@@ -78,70 +151,21 @@ def _top_k_dispatch(probs: jax.Array, cfg: MoEConfig, capacity: int):
     [T,E,C] fp32 gates, aux_loss scalar). Tokens beyond an expert's capacity
     are dropped (their combine weight is zero), the Switch/GShard contract.
     """
-    T, E = probs.shape
-    remaining = probs
-    # occupancy count per expert, accumulated across the k rounds
-    occupancy = jnp.zeros((E,), jnp.int32)
-    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
-    combine = jnp.zeros((T, E, capacity), probs.dtype)
-    importance = jnp.zeros((E,), probs.dtype)  # fraction routed, for aux loss
-
-    for _ in range(cfg.top_k):
-        idx = jnp.argmax(remaining, axis=-1)                      # [T]
-        gate = jnp.take_along_axis(remaining, idx[:, None], -1)[:, 0]
-        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)        # [T,E]
-        # position of each token in its expert's queue this round, offset by
-        # seats taken in earlier rounds
-        pos_in_round = jnp.cumsum(onehot, axis=0) - onehot        # [T,E]
-        pos = pos_in_round + occupancy[None, :]
-        within = (pos < capacity) & (onehot > 0)
-        pos_clipped = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
-        slot = jax.nn.one_hot(pos_clipped, capacity, dtype=probs.dtype)  # [T,E,C]
-        sel = (within.astype(probs.dtype))[..., None] * slot
-        dispatch = dispatch + sel
-        combine = combine + gate[:, None, None] * sel
-        occupancy = occupancy + jnp.sum(onehot, axis=0).astype(jnp.int32)
-        importance = importance + jnp.mean(onehot, axis=0)
-        remaining = remaining * (1.0 - onehot)                    # mask chosen
-
-    # load-balancing aux loss (Switch eq. 4): E * sum(frac_routed * mean_prob)
-    aux = cfg.n_experts * jnp.sum(importance / cfg.top_k * jnp.mean(probs, axis=0))
-    # renormalise combine weights over the selected experts
+    E = probs.shape[1]
+    sel, gates, pos, aux = _top_k_select(probs, cfg)
+    within = (pos < capacity).astype(jnp.float32)             # [T, k]
+    oh_e = jax.nn.one_hot(sel, E, dtype=jnp.float32)          # [T, k, E]
+    oh_c = jax.nn.one_hot(
+        jnp.clip(pos, 0, capacity - 1), capacity, dtype=jnp.float32
+    )                                                         # [T, k, C]
+    dispatch = jnp.einsum("tke,tkc->tec", oh_e * within[..., None], oh_c)
+    combine = jnp.einsum(
+        "tke,tkc->tec", oh_e * (gates * within)[..., None], oh_c
+    )
+    # renormalise combine weights over the selected (and kept) experts
     denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
     combine = combine / jnp.maximum(denom, 1e-9)
     return dispatch, combine, aux
-
-
-def _top_k_routes(probs: jax.Array, cfg: MoEConfig, capacity: int):
-    """Per-round routing decisions without materialising [T,E,C] tensors.
-
-    probs: [T, E] float32. Returns (rounds, aux) where rounds is a list of
-    ``(idx [T] int32, gate [T] fp32, pos [T] int32, valid [T] bool)`` — the
-    chosen expert, its gate value, the token's position in that expert's
-    queue, and whether it is within capacity. Identical selection/drop
-    semantics to the one-hot reference path (same argmax order, same
-    occupancy-offset positions)."""
-    T, E = probs.shape
-    remaining = probs
-    occupancy = jnp.zeros((E,), jnp.int32)
-    importance = jnp.zeros((E,), probs.dtype)
-    rounds = []
-    for _ in range(cfg.top_k):
-        idx = jnp.argmax(remaining, axis=-1)                      # [T]
-        gate = jnp.take_along_axis(remaining, idx[:, None], -1)[:, 0]
-        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)        # [T,E]
-        pos_in_round = (jnp.cumsum(onehot, axis=0) - onehot).astype(jnp.int32)
-        pos = (
-            jnp.take_along_axis(pos_in_round, idx[:, None], -1)[:, 0]
-            + occupancy[idx]
-        )
-        valid = pos < capacity
-        rounds.append((idx.astype(jnp.int32), gate, pos, valid))
-        occupancy = occupancy + jnp.sum(onehot, axis=0).astype(jnp.int32)
-        importance = importance + jnp.mean(onehot, axis=0)
-        remaining = remaining * (1.0 - onehot)
-    aux = cfg.n_experts * jnp.sum(importance / cfg.top_k * jnp.mean(probs, axis=0))
-    return rounds, aux
 
 
 def _moe_gather(params: dict[str, Any], flat: jax.Array, cfg: MoEConfig,
@@ -150,20 +174,23 @@ def _moe_gather(params: dict[str, Any], flat: jax.Array, cfg: MoEConfig,
     of int32), gather tokens into [E,C,D], run the expert FFN, and gather
     each token's expert outputs back with gate weighting. Data movement is
     O(E*C*D + k*T*D) with ZERO routing matmul FLOPs — vs the one-hot
-    einsums' 2*T*E*C*D FLOPs each way, which at bench shapes (T=8192, E=4,
-    C=5120, D=1024) cost ~2x the expert FFN itself (the measured reason
-    behind the round-3 22% MoE MFU; docs/PERF.md)."""
+    einsums' 2*T*E*C*D FLOPs each way (the measured reason behind the
+    round-3 22% MoE MFU; docs/PERF.md). Same capacity/drop semantics as the
+    einsum reference."""
     T, D = flat.shape
-    E = cfg.n_experts
-    rounds, aux = _top_k_routes(probs, cfg, capacity)
+    E, k = cfg.n_experts, cfg.top_k
+    sel, gates, pos, aux = _top_k_select(probs, cfg)
+    valid = pos < capacity                                    # [T, k]
+    flat_slot = (sel * capacity + jnp.clip(pos, 0, capacity - 1)).reshape(T * k)
+    tok = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
 
-    # slot -> token map; sentinel T points at a zero pad row (empty slots)
-    slot_token = jnp.full((E * capacity,), T, jnp.int32)
-    arange_t = jnp.arange(T, dtype=jnp.int32)
-    for idx, _, pos, valid in rounds:
-        flat_slot = idx * capacity + jnp.clip(pos, 0, capacity - 1)
-        target = jnp.where(valid, flat_slot, E * capacity)  # OOB -> dropped
-        slot_token = slot_token.at[target].set(arange_t, mode="drop")
+    # slot -> token map; sentinel T points at a zero pad row (empty slots);
+    # kept slots are unique (pos is the global occupancy rank), so one
+    # scatter covers all k rounds
+    target = jnp.where(valid.reshape(T * k), flat_slot, E * capacity)
+    slot_token = (
+        jnp.full((E * capacity,), T, jnp.int32).at[target].set(tok, mode="drop")
+    )
 
     padded = jnp.concatenate([flat, jnp.zeros((1, D), flat.dtype)], axis=0)
     expert_in = padded[slot_token].reshape(E, capacity, D)
@@ -173,34 +200,161 @@ def _moe_gather(params: dict[str, Any], flat: jax.Array, cfg: MoEConfig,
 
     # combine: each token gathers its (<= k) expert outputs, gate-weighted
     # and renormalised over the experts that actually kept it
-    denom = sum(
-        gate * valid.astype(gate.dtype) for _, gate, _, valid in rounds
-    )
-    denom = jnp.maximum(denom, 1e-9)
+    denom = jnp.maximum(jnp.sum(gates * valid, axis=1), 1e-9)  # [T]
     out_flat = expert_out.reshape(E * capacity, D)
-    y = jnp.zeros((T, D), flat.dtype)
-    for idx, gate, pos, valid in rounds:
-        flat_slot = idx * capacity + jnp.clip(pos, 0, capacity - 1)
-        tok_out = out_flat[jnp.where(valid, flat_slot, 0)]
-        w = (gate * valid.astype(gate.dtype) / denom).astype(flat.dtype)
-        y = y + w[:, None] * tok_out
+    tok_out = out_flat[jnp.where(valid.reshape(T * k), flat_slot, 0)]
+    w = ((gates * valid) / denom[:, None]).reshape(T * k).astype(flat.dtype)
+    y = jnp.zeros((T, D), flat.dtype).at[tok].add(w[:, None] * tok_out)
     return y, aux
+
+
+# --- grouped (dropless) dispatch ----------------------------------------------
+
+
+def _grouped_ffn(params: dict[str, Any], flat: jax.Array, tok: jax.Array,
+                 group: jax.Array, weight: jax.Array, n_groups: int,
+                 cfg: MoEConfig) -> jax.Array:
+    """Sorted grouped-GEMM expert FFN over a flat route list.
+
+    ``tok``/``group``/``weight``: [R] routes — the token row each route
+    reads, its expert group in [0, n_groups), and its final combine weight
+    (gate/denom, already zeroed for routes this shard doesn't own). Sorts
+    routes by group (stable), scatters token rows into a block-aligned
+    padded buffer (tony_tpu.ops.grouped_mm.grouped_layout), runs the SwiGLU
+    FFN as three grouped matmuls, and scatter-adds the weighted outputs back
+    per token. Returns [T, D]."""
+    from tony_tpu.ops.grouped_mm import grouped_layout, grouped_matmul
+
+    T, D = flat.shape
+    R = tok.shape[0]
+    block = cfg.group_block
+    order = jnp.argsort(group, stable=True)
+    g_s, tok_s, w_s = group[order], tok[order], weight[order]
+    sizes = jnp.bincount(group, length=n_groups)
+    n_tiles = -(-R // block) + n_groups  # static bound: 1 part tile/group
+    starts, tile_group = grouped_layout(sizes, block, n_tiles)
+    compact_start = jnp.cumsum(sizes) - sizes
+    dst = starts[g_s] + (jnp.arange(R, dtype=jnp.int32) - compact_start[g_s])
+
+    x_pad = (
+        jnp.zeros((n_tiles * block, D), flat.dtype).at[dst].set(flat[tok_s])
+    )
+    gmm = partial(grouped_matmul, tile_group=tile_group, impl=cfg.gmm_impl)
+    h = jax.nn.silu(gmm(x_pad, params["w1"])) * gmm(x_pad, params["w3"])
+    y_pad = gmm(h, params["w2"])
+    contrib = w_s.astype(flat.dtype)[:, None] * y_pad[dst]
+    return jnp.zeros((T, D), flat.dtype).at[tok_s].add(contrib)
+
+
+def _moe_grouped(params: dict[str, Any], flat: jax.Array, cfg: MoEConfig,
+                 probs: jax.Array):
+    """Dropless grouped dispatch: every route is served (no capacity), the
+    combine weight is the gate renormalised over all k selections."""
+    T, _ = flat.shape
+    k = cfg.top_k
+    sel, gates, _, aux = _top_k_select(probs, cfg)  # pos unused: dropless
+    denom = jnp.maximum(jnp.sum(gates, axis=1), 1e-9)
+    tok = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+    weight = (gates / denom[:, None]).reshape(T * k)
+    y = _grouped_ffn(params, flat, tok, sel.reshape(T * k), weight,
+                     cfg.n_experts, cfg)
+    return y, aux
+
+
+def _moe_grouped_ep(params: dict[str, Any], flat: jax.Array, cfg: MoEConfig,
+                    probs: jax.Array, mesh):
+    """Expert-parallel grouped dispatch: shard_map where each ``ep`` shard
+    runs the grouped FFN for its E/ep local experts only and the
+    token-indexed combine is a psum over ``ep``. The token dim stays sharded
+    over the data axes (the ``sharded_fused_ce_tokens`` pattern — only ep is
+    gathered), so per-shard work scales with the LOCAL batch. Expert-weight
+    streaming — the measured round-4 MoE bottleneck — shards by ep; per-
+    shard row work stays worst-case-bounded at T_local*k (routes to remote
+    experts ride along with zero combine weight — the static-shape cost of
+    dropless EP, since routing counts are data-dependent). Routing (fp32)
+    and the aux loss stay outside the manual region."""
+    from jax.sharding import PartitionSpec as P
+
+    from tony_tpu.ops.compat import shard_map_compat
+
+    k = cfg.top_k
+    ep = int(mesh.shape["ep"])
+    e_local = cfg.n_experts // ep
+    sel, gates, _, aux = _top_k_select(probs, cfg)
+    denom = jnp.maximum(jnp.sum(gates, axis=1), 1e-9)
+    weight = gates / denom[:, None]                           # [T, k]
+
+    def local(w1, w3, w2, flat_, sel_, weight_):
+        t = flat_.shape[0]                                    # T / (dp*fsdp)
+        off = jax.lax.axis_index("ep") * e_local
+        rel = sel_ - off
+        mine = (rel >= 0) & (rel < e_local)
+        grp = jnp.where(mine, rel, 0).reshape(t * k)
+        wgt = jnp.where(mine, weight_, 0.0).reshape(t * k)
+        tok = jnp.repeat(jnp.arange(t, dtype=jnp.int32), k)
+        y = _grouped_ffn({"w1": w1, "w3": w3, "w2": w2}, flat_, tok, grp,
+                         wgt, e_local, cfg)
+        return jax.lax.psum(y, "ep")
+
+    axes = set(mesh.axis_names)
+    batch = tuple(a for a in ("dp", "fsdp") if a in axes) or None
+    wspec = P("ep", None, None)
+    bspec = P(batch, None)
+    y = shard_map_compat(
+        local,
+        mesh=mesh,
+        in_specs=(wspec, wspec, wspec, bspec, bspec, bspec),
+        out_specs=bspec,
+    )(params["w1"], params["w3"], params["w2"], flat, sel, weight)
+    return y, aux
+
+
+def _moe_grouped_entry(params, flat, cfg, probs):
+    from tony_tpu.parallel.mesh import get_default_mesh, inside_manual_region
+
+    mesh = get_default_mesh()
+    if (
+        mesh is not None
+        and int(mesh.shape.get("ep", 1)) > 1
+        # the manual region is ep-only: a tp-sharded ffn dim would be
+        # all-gathered into every shard inside it (4x weight HBM on tp=4 —
+        # exactly the streaming this path exists to shrink), so ep x tp
+        # meshes stay on the plain GSPMD path, which partitions the ffn
+        # einsums itself
+        and int(mesh.shape.get("tp", 1)) == 1
+        and cfg.n_experts % int(mesh.shape["ep"]) == 0
+        # the ep shard_map keeps tokens sharded over the data axes, which
+        # needs an even split; odd batches take the plain GSPMD path
+        and flat.shape[0]
+        % (int(mesh.shape.get("dp", 1)) * int(mesh.shape.get("fsdp", 1)))
+        == 0
+        and not inside_manual_region()
+    ):
+        return _moe_grouped_ep(params, flat, cfg, probs, mesh)
+    return _moe_grouped(params, flat, cfg, probs)
 
 
 def moe_block(params: dict[str, Any], x: jax.Array, cfg: MoEConfig):
     """MoE SwiGLU FFN. x: [B, S, D] -> (y [B, S, D], aux_loss scalar).
 
-    Dropped (over-capacity) tokens pass through with a zero FFN delta, so the
-    residual connection outside this block keeps their representation.
+    Capacity dispatches ('gather'/'einsum'): dropped (over-capacity) tokens
+    pass through with a zero FFN delta, so the residual connection outside
+    this block keeps their representation. 'grouped' is dropless — every
+    routed token is served.
     """
     B, S, D = x.shape
     T = B * S
     flat = x.reshape(T, D)
-    capacity = cfg.capacity(T)
 
-    logits = flat.astype(jnp.float32) @ params["router"]
+    # router math is ALWAYS fp32: a bf16 softmax loses ~2 decimal digits and
+    # the aux loss is a mean of small per-expert fractions
+    logits = flat.astype(jnp.float32) @ params["router"].astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
 
+    if cfg.dispatch == "grouped":
+        y, aux = _moe_grouped_entry(params, flat, cfg, probs)
+        return y.reshape(B, S, D), aux
+    capacity = cfg.capacity(T)
     if cfg.dispatch == "gather":
         y, aux = _moe_gather(params, flat, cfg, capacity, probs)
         return y.reshape(B, S, D), aux
@@ -218,4 +372,7 @@ def moe_block(params: dict[str, Any], x: jax.Array, cfg: MoEConfig):
     return y.reshape(B, S, D), aux
 
 
-__all__ = ["MoEConfig", "init_moe_params", "logical_axes", "moe_block"]
+__all__ = [
+    "MoEConfig", "init_moe_params", "logical_axes", "moe_block",
+    "routing_stats",
+]

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tony_tpu.ops.compat import shard_map_compat as _shard_map
+
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -282,7 +284,7 @@ def make_ring_attention(
                 "(e.g. a pp pipeline stage); use attention_impl='flash' or "
                 "'dot' with pp, or drop pp and shard the sequence with sp"
             )
-        return jax.shard_map(
+        return _shard_map(
             lambda a, b, c: inner(a, b, c),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -337,7 +339,7 @@ def make_ring_flash_attention(mesh: Mesh, *, axis_name: str = "sp"):
         # stays on — same vma discipline as the dense ring path.
         from tony_tpu.ops.attention import _use_interpret
 
-        return jax.shard_map(
+        return _shard_map(
             lambda a, b, c: ring_flash_attention_local(
                 a, b, c, axis_name, blk_q, blk_k
             ),

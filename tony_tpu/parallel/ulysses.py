@@ -20,6 +20,7 @@ from jax import lax
 from jax.sharding import Mesh
 
 from tony_tpu.models.llama import dot_attention as _causal_attention
+from tony_tpu.ops.compat import shard_map_compat as _shard_map
 
 
 def ulysses_attention_local(
@@ -67,7 +68,7 @@ def make_ulysses_attention(mesh: Mesh, *, axis_name: str = "sp"):
                 "region (e.g. a pp pipeline stage); use attention_impl="
                 "'flash' or 'dot' with pp, or drop pp"
             )
-        return jax.shard_map(
+        return _shard_map(
             lambda a, b, c: inner(a, b, c),
             mesh=mesh,
             in_specs=(spec, spec, spec),

@@ -87,6 +87,12 @@ class FitConfig:
     # 'pallas' select the fused chunked CE (tony_tpu.ops.fused_ce — no
     # [B,S,V] logits transient), 'dense' the legacy full-logits head
     ce_impl: str = ""
+    # MoE dispatch override: '' keeps model.moe_dispatch; 'grouped' selects
+    # the dropless sorted grouped GEMM, 'gather'/'einsum' the capacity paths
+    # (tony_tpu.parallel.moe — docs/PERF.md "Grouped MoE")
+    moe_dispatch: str = ""
+    # grouped-GEMM row tile override (0 keeps model.moe_group_block)
+    moe_group_block: int = 0
 
     def apply_job_env(self) -> None:
         """Fill unset checkpoint fields from the TONY_CHECKPOINT_* env the
@@ -127,10 +133,17 @@ def _start_async_host_copy(metrics: dict) -> None:
 def _fit(cfg: FitConfig) -> dict:
     jax_tpu.initialize()  # no-op outside a tony-tpu job
     cfg.apply_job_env()
-    if cfg.ce_impl:
+    if cfg.ce_impl or cfg.moe_dispatch or cfg.moe_group_block:
         from dataclasses import replace as _replace
 
-        cfg.model = _replace(cfg.model, ce_impl=cfg.ce_impl)
+        overrides = {}
+        if cfg.ce_impl:
+            overrides["ce_impl"] = cfg.ce_impl
+        if cfg.moe_dispatch:
+            overrides["moe_dispatch"] = cfg.moe_dispatch
+        if cfg.moe_group_block:
+            overrides["moe_group_block"] = cfg.moe_group_block
+        cfg.model = _replace(cfg.model, **overrides)
     cache_dir = os.environ.get("TONY_JAX_CACHE_DIR", "")
     if cache_dir:
         # persistent XLA compilation cache (train.jax_cache, default on):
