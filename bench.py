@@ -398,6 +398,230 @@ def moe_bench(steps: int = 10) -> dict:
     return out
 
 
+def decode_bench(on_tpu: bool) -> dict:
+    """Serving throughput (the decode counterpart of the training
+    headline): the continuous-batching engine over a request trace.
+
+    Reports decode tokens/s/chip, TTFT, and slot occupancy for
+    (a) sequential batch-1 decode (one slot: the pre-engine serving
+    pattern — a request owns the whole 'batch'), (b) all-slots continuous
+    batching over the SAME trace, and (c) steady state under a mixed
+    arrival trace (new request every other step). Decode at these shapes
+    is HBM-bandwidth-bound on the weights, so batching slots is nearly
+    free: the full-slot engine targets >= 4x the sequential tokens/s.
+    Also times the native-GQA decode kernel vs the repeat-expanded
+    reference at the same shapes."""
+    import numpy as np
+
+    from tony_tpu.models.llama import LlamaConfig, init_params
+    from tony_tpu.ops.decode_attention import (
+        decode_attention, reference_decode_attention,
+    )
+    from tony_tpu.serve import Engine, Request, ServeConfig
+
+    if on_tpu:
+        # bench_1b4 trunk at llama3-style 4:1 GQA (16 q heads / 4 kv heads)
+        import dataclasses
+
+        cfg = dataclasses.replace(LlamaConfig.bench_1b4(), n_kv_heads=4)
+        slots, max_len, block = 8, 1024, 128
+        n_req, max_new = 16, 64
+        prompt_lens = [64, 128, 192, 256, 384, 512]
+        kern_T = 1024
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, max_len, block = 4, 64, 8
+        n_req, max_new = 6, 4
+        prompt_lens = [3, 5, 9, 14]
+        kern_T = 64
+    params = init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+
+    def trace():
+        return [
+            Request(
+                prompt=rng.integers(
+                    0, cfg.vocab_size, prompt_lens[i % len(prompt_lens)]
+                ),
+                max_new_tokens=max_new,
+                rng=i,
+            )
+            for i in range(n_req)
+        ]
+
+    def serve_cfg(s):
+        return ServeConfig(slots=s, max_len=max_len, kv_block=block)
+
+    def warmed(s):
+        """Engine with every bucket/capacity compile paid before timing:
+        the reported tokens/s is steady-state serving, not XLA compiles."""
+        eng = Engine(params, cfg, serve_cfg(s))
+        eng.run([
+            Request(prompt=rng.integers(0, cfg.vocab_size, pl),
+                    max_new_tokens=max_new)
+            for pl in prompt_lens
+        ])
+        # a lone short request after the drain reaches the shrunk-capacity
+        # compiles the timed trace would otherwise pay mid-run
+        eng.run([Request(prompt=rng.integers(0, cfg.vocab_size, prompt_lens[0]),
+                         max_new_tokens=2)])
+        eng.reset_metrics()
+        return eng
+
+    out = {"model": "bench_1b4_gqa16_4" if on_tpu else "tiny",
+           "slots": slots, "max_new_tokens": max_new, "n_requests": n_req}
+
+    # (a) sequential batch-1: the trace drains one request at a time
+    eng1 = warmed(1)
+    eng1.run(trace())
+    out["sequential_b1"] = eng1.metrics.summary()
+
+    # (b) full-slot continuous batching, same trace submitted upfront
+    engS = warmed(slots)
+    engS.run(trace())
+    out["continuous"] = engS.metrics.summary()
+    s1 = eng1.metrics.tokens_per_sec_per_chip
+    sS = engS.metrics.tokens_per_sec_per_chip
+    if s1 > 0:
+        out["continuous_vs_b1"] = round(sS / s1, 2)
+
+    # (c) steady state under a mixed arrival trace: half the requests
+    # queued upfront, one more lands every other decode step
+    engM = warmed(slots)
+    reqs = trace()
+    for r in reqs[: max(1, n_req // 2)]:
+        engM.submit(r)
+    rest = reqs[max(1, n_req // 2):]
+    i = 0
+    while engM._queue or engM.n_live or rest:
+        if rest and i % 2 == 0:
+            engM.submit(rest.pop(0))
+        engM.step()
+        i += 1
+    out["mixed_arrivals"] = engM.metrics.summary()
+
+    # native-GQA decode kernel vs the repeat-expanded reference (one
+    # decode step of attention at full cache length, layer-scanned so
+    # dispatch overhead amortises)
+    H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (slots, H, hd), cfg.dtype)
+    kc = jax.random.normal(ks[1], (slots, Hkv, kern_T, hd), cfg.dtype)
+    vc = jax.random.normal(ks[2], (slots, Hkv, kern_T, hd), cfg.dtype)
+    lengths = jnp.full((slots,), kern_T, jnp.int32)
+    reps = cfg.n_layers
+
+    def timed(fn):
+        def loss(qq):
+            def body(c, _):
+                return fn(c), None
+
+            o, _ = jax.lax.scan(body, qq, None, length=reps)
+            return o
+
+        try:
+            f = jax.jit(loss)
+            _fence(f(q)); _fence(f(q))
+            t0 = time.perf_counter()
+            n = 8
+            for _ in range(n):
+                o = f(q)
+            _fence(o)
+            return {"ms": round((time.perf_counter() - t0) / n * 1e3, 2)}
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+
+    kern = {
+        "native_scan": timed(lambda a: decode_attention(
+            a, kc, vc, lengths, impl="scan", block=block)),
+        "repeat_reference": timed(lambda a: reference_decode_attention(
+            a, kc, vc, lengths)),
+    }
+    if on_tpu:
+        kern["native_pallas"] = timed(lambda a: decode_attention(
+            a, kc, vc, lengths, impl="pallas", block=block))
+    out["decode_kernel_T%d" % kern_T] = kern
+    return out
+
+
+def gqa_capacity_demo() -> dict:
+    """Max concurrent decode slots at bench_1b4 GQA shapes: the native
+    n_kv_heads cache vs a repeat-expanded (n_heads-wide) one — the HBM
+    headroom the native-GQA decode kernel buys, since the repeat layout
+    keeps every slot's K/V resident at n_heads width. Computed from the
+    chip's HBM budget (bytes_limit when a device reports one, the v5e 16GB
+    otherwise) minus resident params; the ratio is exactly the GQA factor."""
+    from tony_tpu.models.llama import LlamaConfig
+
+    import dataclasses
+
+    cfg = dataclasses.replace(LlamaConfig.bench_1b4(), n_kv_heads=4)
+    max_len = 2048
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        hbm = int(stats.get("bytes_limit", 16 * 2**30))
+    except Exception:
+        hbm = 16 * 2**30
+    param_bytes = cfg.n_params * 2  # bf16 resident weights
+    budget = int(hbm * 0.92) - param_bytes  # ~8% runtime/fragmentation
+    per_slot_native = 2 * cfg.n_layers * max_len * cfg.n_kv_heads * cfg.head_dim * 2
+    per_slot_repeat = 2 * cfg.n_layers * max_len * cfg.n_heads * cfg.head_dim * 2
+    native = max(0, budget // per_slot_native)
+    repeat = max(0, budget // per_slot_repeat)
+    return {
+        "model": "bench_1b4_gqa16_4",
+        "max_len": max_len,
+        "hbm_gb": round(hbm / 2**30, 1),
+        "param_gb": round(param_bytes / 2**30, 2),
+        "kv_bytes_per_slot_native": per_slot_native,
+        "kv_bytes_per_slot_repeat": per_slot_repeat,
+        "max_slots_native": int(native),
+        "max_slots_repeat": int(repeat),
+        "native_vs_repeat": round(native / max(repeat, 1), 2),
+        "note": "budget-derived (HBM minus resident params); the ratio is "
+                "the GQA factor n_heads/n_kv_heads",
+    }
+
+
+def pipeline_bench() -> dict:
+    """GPipe vs 1F1B wall-clock + bubble fraction: runs scripts/pp_bench.py
+    in a subprocess on the virtual 8-CPU mesh (the pp mesh needs its own
+    device count / platform, which must not disturb this process's
+    backend). Results land in docs/PERF.md "Pipeline"."""
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": root,
+    }
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "scripts", "pp_bench.py")],
+            capture_output=True, text=True, timeout=850, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "pp_bench timed out"}
+    out = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                r = json.loads(line)
+                out[r.pop("schedule")] = r
+            except (ValueError, KeyError):
+                pass
+    if "gpipe" in out and "1f1b" in out and out["1f1b"]["step_ms"]:
+        out["gpipe_vs_1f1b"] = round(
+            out["gpipe"]["step_ms"] / out["1f1b"]["step_ms"], 3
+        )
+    if not out:
+        out["error"] = (proc.stderr or "no output")[-300:]
+    return out
+
+
 def overlap_bench(cfg, batch: int, seq: int, steps: int, mu_dtype: str) -> dict:
     """fit()-driven input-pipeline benchmark. train_bench() feeds a
     pre-staged device batch (no input pipeline at all); this runs the REAL
@@ -505,6 +729,11 @@ def run_bench() -> dict:
             )
         except Exception as e:
             extra["overlap_fit"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+        try:
+            extra["decode"] = decode_bench(on_tpu=False)
+        except Exception as e:
+            extra["decode"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+        extra["gqa_capacity"] = gqa_capacity_demo()
         return {
             "metric": "llama_tiny_cpu_tokens_per_sec",
             "value": r["tokens_per_sec_per_chip"],
@@ -577,6 +806,17 @@ def run_bench() -> dict:
             extra["startup_phases"] = p2["startup"]
     except Exception as e:
         extra["overlap_fit"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    try:
+        # serving: continuous batching vs sequential batch-1 + TTFT + slot
+        # occupancy (the decode counterpart of the training headline)
+        extra["decode"] = decode_bench(on_tpu=True)
+    except Exception as e:
+        extra["decode"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    extra["gqa_capacity"] = gqa_capacity_demo()
+    try:
+        extra["pipeline"] = pipeline_bench()
+    except Exception as e:
+        extra["pipeline"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
     try:
         extra["submit_to_first_step_s"] = submit_latency_bench()
     except Exception as e:

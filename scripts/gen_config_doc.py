@@ -62,6 +62,7 @@ def build() -> str:
         lines.append(f"| `{s}` | {suffix_doc.get(s, '')} |")
     lines += _data_config_section()
     lines += _fit_config_section()
+    lines += _serve_config_section()
     return "\n".join(lines) + "\n"
 
 
@@ -179,6 +180,47 @@ def _fit_config_section() -> list[str]:
     for f in dataclasses.fields(FitConfig):
         if f.name in skip:
             continue
+        default = f.default
+        default = '""' if default == "" else f"{default}"
+        lines.append(f"| `{f.name}` | `{default}` | {notes.get(f.name, '')} |")
+    return lines
+
+
+def _serve_config_section() -> list[str]:
+    """Document the decode engine's knobs (`ServeConfig`, Python API —
+    docs/SERVE.md has the architecture and sizing guidance)."""
+    import dataclasses
+
+    from tony_tpu.serve.engine import ServeConfig
+
+    notes = {
+        "slots": "concurrent decode slots (the static batch width of the "
+                 "one jitted decode step); a finished request frees its "
+                 "slot for the admission queue",
+        "max_len": "longest prompt+generation admitted (0 -> "
+                   "model.max_seq_len)",
+        "kv_block": "KV cache block size: capacity grows/shrinks in "
+                    "multiples of this and the decode kernel tiles the "
+                    "sequence by it (docs/SERVE.md)",
+        "prefill_buckets": "prompt pad lengths — prefill compiles once per "
+                           "bucket (bounded compile count); () -> powers "
+                           "of two from 16 up to max_len",
+        "decode_impl": "decode attention kernel: scan (pure XLA, default) "
+                       "\\| pallas (TPU kernel, interpreted on CPU) — "
+                       "tony_tpu.ops.decode_attention",
+        "max_top_k": "static top-k slice width for sampling; per-request "
+                     "top_k clamps to it, and top-p-only requests use it "
+                     "as the bounded nucleus candidate set",
+        "shrink": "release cache blocks when the live maximum drops to "
+                  "half the capacity (each capacity change recompiles the "
+                  "decode step once)",
+    }
+    lines = ["", "## Serving (`ServeConfig`, Python API)", "",
+             "Set on `Engine(params, cfg, ServeConfig(...))` "
+             "(tony_tpu.serve); `generate()` builds one internally. These "
+             "are not job-file keys.", "",
+             "| field | default | notes |", "|---|---|---|"]
+    for f in dataclasses.fields(ServeConfig):
         default = f.default
         default = '""' if default == "" else f"{default}"
         lines.append(f"| `{f.name}` | `{default}` | {notes.get(f.name, '')} |")

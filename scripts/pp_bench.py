@@ -46,6 +46,8 @@ def run(schedule: str) -> dict:
     lowered = jax.jit(step).lower(state, inp, tgt)
     compiled = lowered.compile()
     cost = compiled.cost_analysis()
+    if isinstance(cost, list):  # older jax wraps the dict in a list
+        cost = cost[0] if cost else {}
     flops = float(cost.get("flops", -1)) if cost else -1.0
 
     state2, m = step(state, inp, tgt)  # compile+run once
@@ -56,10 +58,18 @@ def run(schedule: str) -> dict:
         state2, m = step(state2, inp, tgt)
     jax.block_until_ready(m["loss"])
     dt = (time.perf_counter() - t0) / n
+    mem = compiled.memory_analysis()
     return {
         "schedule": schedule,
         "step_ms": round(dt * 1e3, 1),
         "compiled_gflops": round(flops / 1e9, 2),
+        # the schedule's idle fraction: each stage sits out (P-1) of the
+        # (M + P-1) ticks (GPipe and non-interleaved 1F1B share the flush
+        # bubble; 1F1B's win is O(P) activation memory, visible in temp)
+        "bubble_frac": round((PP - 1) / (M + PP - 1), 4),
+        "pp": PP,
+        "microbatches": M,
+        "temp_mb": round(mem.temp_size_in_bytes / 2**20, 1),
         "loss": round(float(m["loss"]), 4),
     }
 

@@ -61,22 +61,50 @@ def test_example_llama_pretrain(tmp_path):
     assert code == 0
 
 
+# worker-log fragments that identify the ONE known-benign failure mode:
+# torch.distributed's gloo rendezvous cannot resolve/connect in an offline
+# sandbox. Anything else (import errors, crashed training code, submission
+# machinery) is a real failure and must fail the test.
+GLOO_OFFLINE_SIGNATURES = (
+    # specific rendezvous/transport markers only — a bare "gloo" would also
+    # match example source lines quoted in unrelated tracebacks
+    "connectFullMesh",
+    "ProcessGroupGloo",
+    "DistNetworkError",
+    "Connection refused",
+    "Network is unreachable",
+    "No route to host",
+    "Name or service not known",
+    "Temporary failure in name resolution",
+)
+
+
 @pytest.mark.slow
 def test_example_bert_pytorch(tmp_path):
     """Milestone config #3 shape: torch DDP gloo rendezvous from the
-    PyTorchRuntime env contract."""
+    PyTorchRuntime env contract. A nonzero exit is expected (xfail) ONLY
+    for the known gloo-offline signature; any other failure is real."""
     pytest.importorskip("torch")
     code = submit_example("bert_pytorch", tmp_path)
     if code != 0:
-        # torch gloo rendezvous can be flaky in offline sandboxes; surface
-        # the logs but only fail if the submission machinery itself broke
+        # surface the worker logs either way, and decide from their content
+        combined = []
         apps = [d for d in os.listdir(tmp_path) if os.path.isdir(tmp_path / d)]
         for app in apps:
             logs = tmp_path / app / "logs"
             if logs.is_dir():
                 for n in sorted(os.listdir(logs)):
-                    sys.stderr.write(
-                        f"===== {n}\n"
-                        + open(logs / n, errors="replace").read()[-2000:]
-                    )
-        pytest.xfail(f"bert_pytorch example exited {code} (gloo offline)")
+                    text = open(logs / n, errors="replace").read()
+                    combined.append(text)
+                    sys.stderr.write(f"===== {n}\n" + text[-2000:])
+        text = "\n".join(combined)
+        if any(sig in text for sig in GLOO_OFFLINE_SIGNATURES):
+            pytest.xfail(f"bert_pytorch example exited {code} (gloo offline)")
+        if not text.strip():
+            # workers died before writing any log: nothing to attribute the
+            # failure to either way — keep the conservative xfail
+            pytest.xfail(f"bert_pytorch example exited {code} (no worker logs)")
+        pytest.fail(
+            f"bert_pytorch example exited {code} without the gloo-offline "
+            "signature — not the known-benign rendezvous failure"
+        )

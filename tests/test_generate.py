@@ -111,6 +111,83 @@ def test_top_p_restricts_to_nucleus():
     assert 0 in seen
 
 
+def _legacy_sample(logits, temperature, top_k, top_p, rng):
+    """The pre-round-9 sampler: full-vocab descending jnp.sort per call
+    (V log V per decode step) — kept verbatim as the value oracle for the
+    sort-free lax.top_k rewrite."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0 or top_p > 0.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        if top_k > 0:
+            kth = sorted_logits[:, top_k - 1][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            sorted_logits = jnp.where(
+                sorted_logits < kth, -jnp.inf, sorted_logits
+            )
+        if top_p > 0.0:
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = (cum - probs) < top_p
+            cutoff = jnp.min(
+                jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1
+            )[:, None]
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def test_sample_matches_legacy_sort_impl_topk():
+    """The sort-free sampler (lax.top_k + scatter-back) draws EXACTLY the
+    legacy full-sort sampler's tokens for any top_k config: identical
+    masked logits, identical categorical call, same rng."""
+    from tony_tpu.models.generate import _sample
+
+    logits = jax.random.normal(jax.random.key(0), (8, 500)) * 3.0
+    for temperature, top_k, top_p in [
+        (0.7, 10, 0.0), (1.0, 1, 0.0), (1.3, 40, 0.9), (0.5, 499, 0.3),
+    ]:
+        for seed in range(5):
+            rng = jax.random.key(seed)
+            want = _legacy_sample(logits, temperature, top_k, top_p, rng)
+            got = _sample(logits, temperature, top_k, top_p, rng)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_sample_matches_legacy_sort_impl_top_p_only():
+    """top-p without top_k uses the bounded default-k slice; for vocab <=
+    DEFAULT_NUCLEUS_K the slice is the whole sorted vocab, so the nucleus
+    cutoff — and the draws — match the legacy sampler exactly."""
+    from tony_tpu.models.generate import DEFAULT_NUCLEUS_K, _sample
+
+    V = DEFAULT_NUCLEUS_K
+    logits = jax.random.normal(jax.random.key(1), (6, V)) * 2.0
+    for top_p in (0.3, 0.7, 0.95):
+        for seed in range(5):
+            rng = jax.random.key(100 + seed)
+            want = _legacy_sample(logits, 0.9, 0, top_p, rng)
+            got = _sample(logits, 0.9, 0, top_p, rng)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_sample_tokens_vectorises_heterogeneous_rows():
+    """The engine's per-row sampler: greedy rows equal argmax regardless of
+    key; top_k=1 rows are deterministic; truncated rows only emit admitted
+    tokens."""
+    from tony_tpu.models.generate import sample_tokens
+
+    logits = jax.random.normal(jax.random.key(2), (4, 64)) * 2.0
+    rngs = jax.random.key_data(jax.random.split(jax.random.key(3), 4))
+    temp = jnp.asarray([0.0, 1.0, 0.8, 1.2], jnp.float32)
+    top_k = jnp.asarray([0, 1, 3, 0], jnp.int32)
+    top_p = jnp.asarray([0.0, 0.0, 0.0, 0.5], jnp.float32)
+    toks = sample_tokens(logits, temp, top_k, top_p, rngs)
+    assert int(toks[0]) == int(jnp.argmax(logits[0]))
+    assert int(toks[1]) == int(jnp.argmax(logits[1]))  # top_k=1 == greedy
+    top3 = set(np.asarray(jax.lax.top_k(logits[2], 3)[1]))
+    assert int(toks[2]) in top3
+
+
 def test_eos_rows_stick():
     """Rows that emit eos keep emitting it (static-shape early stop)."""
     from tony_tpu.models.generate import generate

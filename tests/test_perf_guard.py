@@ -123,3 +123,48 @@ def test_grouped_moe_dispatch_stays_below_einsum_tensors():
     )
     einsum = temp_bytes(dataclasses.replace(base, dispatch="einsum"))
     assert einsum > dispatch_tensors, (grouped, einsum, dispatch_tensors)
+
+
+def test_decode_step_reads_kv_proportional_to_active_blocks():
+    """Compile the serve engine's decode step over block caches of growing
+    capacity and assert (via XLA cost analysis, nothing executed) that its
+    bytes accessed scale with the ACTIVE block count, not max_len: a
+    regression that re-points decode attention at a max_len-sized buffer
+    (the old generate.py ring cache) blows the small-capacity bound by the
+    full KV footprint. At these shapes the full-capacity step accesses
+    ~5x the one-block step; the guard asserts 2.5x headroom on both
+    sides."""
+    from tony_tpu.models.llama import LlamaConfig, init_params
+    from tony_tpu.serve import Engine, ServeConfig
+    from tony_tpu.serve.cache import create_cache
+
+    slots, block, max_len = 4, 16, 512
+    cfg = dataclasses.replace(LlamaConfig.tiny(), max_seq_len=max_len)
+    params = init_params(jax.random.key(0), cfg)
+    eng = Engine(params, cfg, ServeConfig(
+        slots=slots, max_len=max_len, kv_block=block,
+    ))
+
+    def bytes_at(n_blocks):
+        cache = create_cache(cfg, slots, n_blocks, block)
+        compiled = jax.jit(eng._decode_impl).lower(
+            params, cache, eng.state
+        ).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        return float(ca["bytes accessed"])
+
+    small = bytes_at(1)
+    full = bytes_at(max_len // block)
+    # one full-length read of k+v (the cache the old decode walked per step)
+    kv_full = (
+        2 * cfg.n_layers * slots * cfg.n_kv_heads * max_len * cfg.head_dim * 4
+    )
+    assert small < full / 2.5, (
+        f"decode step over a 1-block cache accesses {small / 2**20:.1f}MiB "
+        f"vs {full / 2**20:.1f}MiB at full capacity — decode traffic no "
+        "longer scales with the active prefix"
+    )
+    # and the full-capacity cost is dominated by the KV buffers (the guard
+    # is measuring the cache, not fixed per-step overhead)
+    assert full - small > kv_full, (small, full, kv_full)

@@ -4,22 +4,39 @@ The reference delegates inference entirely (it launches whatever script the
 user brings); here generation is part of the model library. TPU-first
 choices: the cache is a static-shape ring of [L, B, max_len, H_kv, hd]
 buffers updated with dynamic_update_slice (no growing shapes under jit — one
-compile for prefill, one for decode), attention masks by absolute position,
-and the whole decode loop is a single jitted lax.scan with donated cache
-buffers (in-place HBM updates).
+compile for prefill, one for decode), and attention masks by absolute
+position.
+
+``generate()`` is a thin convenience wrapper over the serving engine
+(tony_tpu.serve.engine): each prompt row becomes one request into a
+slot-batched continuous-decoding loop, so the one-off API and the serving
+path share one decode step (native-GQA block-cache attention, sort-free
+sampling) and parity between them is a test, not a hope (tests/test_serve.py).
+
+Sampling is sort-free: ``lax.top_k`` over a bounded slice replaces the full
+``V log V`` descending sort per decode step; nucleus (top-p) truncation runs
+over the sorted top-k slice only (when only top-p is set, a bounded default
+k — ``DEFAULT_NUCLEUS_K`` — caps the slice; at real vocab sizes the mass
+beyond the top 64 logits is negligible, and for V <= k the semantics are
+exact).
 """
 
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-from tony_tpu.models.llama import LlamaConfig, Params, rms_norm, rope_table, apply_rope
+from tony_tpu.models.llama import LlamaConfig, Params, rms_norm, rope_freqs, apply_rope
+
+# bounded top-k slice used for nucleus truncation when no top_k was given:
+# the candidate set for top-p sampling (big enough that the excluded tail
+# carries negligible probability mass; exact whenever vocab <= this)
+DEFAULT_NUCLEUS_K = 64
 
 
 class KVCache(NamedTuple):
@@ -64,6 +81,7 @@ def forward_with_cache(
     start_pos: jax.Array,
     cfg: LlamaConfig,
     last_only: bool = False,
+    last_index: jax.Array | None = None,
 ) -> tuple[jax.Array, KVCache]:
     """tokens [B,S] starting at absolute position start_pos (traced scalar).
 
@@ -74,12 +92,14 @@ def forward_with_cache(
     ``lm_head``, returning logits [B,1,vocab]: prefill needs exactly the
     last position to sample from, and the full projection would build a
     [B,S,V] fp32 tensor (at 7B shapes, ~0.5GB for a 2k prompt) just to
-    discard all but one row.
+    discard all but one row. ``last_index`` (traced scalar) generalises it
+    to an arbitrary position — the engine's bucketed prefill pads prompts
+    up to a bucket length and needs the logits at the *prompt's* last
+    position, not the bucket's.
     """
     B, S = tokens.shape
     x = params["tok_emb"][tokens]
-    half = cfg.head_dim // 2
-    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    freqs = rope_freqs(cfg)
     q_pos = start_pos + jnp.arange(S)
     angles = q_pos.astype(jnp.float32)[:, None] * freqs[None, :]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
@@ -103,7 +123,9 @@ def forward_with_cache(
 
     x, (new_k, new_v) = lax.scan(block, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    if last_only:
+    if last_index is not None:
+        x = lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+    elif last_only:
         x = x[:, -1:]
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, KVCache(new_k, new_v)
@@ -121,80 +143,148 @@ def generate(
     eos_id: int | None = None,
     rng: jax.Array | None = None,
     max_len: int = 0,
+    max_top_k: int = 0,
 ) -> jax.Array:
     """Autoregressive generation. prompt [B,P] -> [B, P+max_new_tokens].
 
     temperature 0 = greedy; otherwise softmax sampling, optionally top-k
     and/or nucleus (top-p) truncated. ``eos_id`` makes finished rows stick
-    at EOS (static shapes: the scan always runs max_new_tokens steps; rows
-    that hit EOS keep emitting it). The decode loop is one jitted lax.scan.
+    at EOS (the output always has max_new_tokens generated positions; rows
+    that hit EOS pad with it).
+
+    Implemented as B requests into the serving engine (one slot per row,
+    prefill bucket = the exact prompt length, same jitted decode step the
+    server runs). Each row gets its own rng stream derived by
+    ``jax.random.split(rng, B)`` — row i's tokens depend only on row i's
+    key, so the same row submitted alone or in a batch samples identically.
+
+    ``max_top_k`` widens the sampler's bounded candidate slice (default
+    ``max(top_k, DEFAULT_NUCLEUS_K)``): top-p-only sampling truncates to
+    the top ``max_top_k`` logits before the nucleus cut, so callers who
+    need a wider nucleus than the top-64 tail raise it here.
     """
+    from tony_tpu.serve.engine import Engine, Request, ServeConfig
+
     B, P = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
     total = P + max_new_tokens
-    cache = KVCache.create(cfg, B, max_len or max(total, 1))
     if rng is None:
         rng = jax.random.key(0)
+    keys = jax.random.split(rng, B)
 
-    # prefill projects only the last position through lm_head (the rest of
-    # the prompt's logits would be discarded by the [:, -1] below anyway)
-    prefill = jax.jit(partial(forward_with_cache, cfg=cfg, last_only=True))
-    logits, cache = prefill(params, prompt, cache, jnp.int32(0))
-    next_rng, rng = jax.random.split(rng)
-    last = _sample(logits[:, -1], temperature, top_k, top_p, next_rng)
-    done0 = (
-        last == eos_id if eos_id is not None else jnp.zeros((B,), bool)
-    )
+    engine = Engine(params, cfg, ServeConfig(
+        slots=B,
+        max_len=max_len or max(total, 1),
+        prefill_buckets=(P,),
+        max_top_k=max(top_k, max_top_k, DEFAULT_NUCLEUS_K),
+    ))
+    prompt_np = np.asarray(prompt)
+    ids = [
+        engine.submit(Request(
+            prompt=prompt_np[i],
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            eos_id=eos_id,
+            rng=keys[i],
+        ))
+        for i in range(B)
+    ]
+    completions = engine.run()
+    rows = []
+    for i, rid in enumerate(ids):
+        toks = list(completions[rid].tokens)
+        if len(toks) < max_new_tokens:  # finished at EOS: stick at it
+            toks += [eos_id] * (max_new_tokens - len(toks))
+        rows.append(np.concatenate([prompt_np[i], np.asarray(toks, np.int32)]))
+    return jnp.asarray(np.stack(rows), jnp.int32)
 
-    def step(carry, rng_step):
-        cache, tok, pos, done = carry
-        logits, cache = forward_with_cache(
-            params, tok[:, None], cache, pos, cfg, last_only=True
-        )
-        nxt = _sample(logits[:, -1], temperature, top_k, top_p, rng_step)
-        if eos_id is not None:
-            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
-            done = done | (nxt == eos_id)
-        return (cache, nxt, pos + 1, done), tok
 
-    # scan emits each step's *input* token, so ys = [last, nxt_1, ...,
-    # nxt_{T-1}] — exactly the max_new_tokens generated tokens in order.
-    steps_rng = jax.random.split(rng, max_new_tokens)
-    _, toks = jax.jit(partial(lax.scan, step))(
-        (cache, last, jnp.int32(P), done0), steps_rng
-    )
-    generated = jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
-    return jnp.concatenate([prompt, generated], axis=1)
+# --- sampling -----------------------------------------------------------------
+
+
+def _truncated_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
+    """[B,V] logits -> [B,V] with everything outside the top-k / nucleus set
+    at -inf. Sort-free: one ``lax.top_k`` over a bounded slice (k, or
+    DEFAULT_NUCLEUS_K when only top-p is set) replaces the full-vocab
+    descending sort; the nucleus cumsum runs over that slice only.
+
+    This static-parameter form is the draw-for-draw parity surface against
+    the legacy sort-based sampler (tests/test_generate.py); the engine's
+    per-row array-parameter twin lives in :func:`sample_tokens` — keep
+    their truncation semantics in lockstep."""
+    V = logits.shape[-1]
+    k = top_k if top_k > 0 else DEFAULT_NUCLEUS_K
+    k = min(k, V)
+    vals, idx = lax.top_k(logits, k)  # [B,k] descending
+    if top_p > 0.0:
+        # nucleus: keep the smallest prefix of the sorted slice whose
+        # cumulative probability reaches top_p (the top token always stays)
+        probs = jax.nn.softmax(vals, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p
+        vals = jnp.where(keep, vals, -jnp.inf)
+    out = jnp.full_like(logits, -jnp.inf)
+    return out.at[jnp.arange(logits.shape[0])[:, None], idx].set(vals)
 
 
 def _sample(logits: jax.Array, temperature: float, top_k: int, top_p: float,
             rng: jax.Array) -> jax.Array:
-    """logits [B,V] -> token ids [B]."""
+    """logits [B,V] -> token ids [B] (static sampling params)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0 or top_p > 0.0:
-        # one descending sort serves both truncations (V log V per decode
-        # step is the dominant cost of sampling at real vocab sizes)
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        if top_k > 0:
-            kth = sorted_logits[:, top_k - 1][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-            sorted_logits = jnp.where(
-                sorted_logits < kth, -jnp.inf, sorted_logits
-            )
-        if top_p > 0.0:
-            # nucleus: keep the smallest prefix of the sorted distribution
-            # whose cumulative probability reaches top_p (the top token
-            # always stays)
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep_sorted = (cum - probs) < top_p
-            # the smallest kept logit per row is the admission threshold
-            cutoff = jnp.min(
-                jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1
-            )[:, None]
-            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        logits = _truncated_logits(logits, top_k, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-__all__ = ["KVCache", "forward_with_cache", "generate"]
+def sample_tokens(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    rngs: jax.Array,
+    *,
+    max_k: int = DEFAULT_NUCLEUS_K,
+) -> jax.Array:
+    """Per-row sampling for the decode engine: logits [N,V], per-row
+    temperature/top_k/top_p arrays [N], per-row rng keys [N] -> tokens [N].
+
+    Rows with temperature <= 0 are greedy; top_k is clamped to the static
+    ``max_k`` slice (0 = no top-k: the slice bound still applies when that
+    row also sets top_p). Same truncation semantics as :func:`_sample`,
+    vectorised over heterogeneous requests sharing one decode step.
+    """
+    N, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    k = min(max_k, V)
+    vals, idx = lax.top_k(scaled, k)  # [N,k] descending
+    eff_k = jnp.where(top_k > 0, jnp.minimum(top_k, k), k)
+    keep = jnp.arange(k)[None, :] < eff_k[:, None]
+    vals = jnp.where(keep, vals, -jnp.inf)
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = jnp.where(
+        top_p[:, None] > 0.0, (cum - probs) < top_p[:, None], True
+    )
+    vals = jnp.where(keep & keep_p, vals, -jnp.inf)
+    truncate = (top_k > 0) | (top_p > 0.0)
+    masked = jnp.full_like(scaled, -jnp.inf).at[
+        jnp.arange(N)[:, None], idx
+    ].set(vals)
+    masked = jnp.where(truncate[:, None], masked, scaled)
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row)
+    )(rngs, masked).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+__all__ = [
+    "DEFAULT_NUCLEUS_K", "KVCache", "forward_with_cache", "generate",
+    "sample_tokens",
+]

@@ -277,10 +277,17 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (x32 * rms).astype(x.dtype) * weight
 
 
+def rope_freqs(cfg: LlamaConfig) -> jax.Array:
+    """Rotary frequency vector [head_dim/2] fp32 — the ONE copy of the
+    formula shared by the train table, the prefill path, and the decode
+    engine (a scaling scheme added here reaches all three)."""
+    half = cfg.head_dim // 2
+    return cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+
+
 def rope_table(cfg: LlamaConfig, seq_len: int, offset: int = 0) -> tuple[jax.Array, jax.Array]:
     """cos/sin tables [seq, head_dim/2], float32."""
-    half = cfg.head_dim // 2
-    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    freqs = rope_freqs(cfg)
     pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
     angles = pos[:, None] * freqs[None, :]
     return jnp.cos(angles), jnp.sin(angles)
@@ -438,15 +445,46 @@ def _remat_policy(name: str):
     return policies[name]
 
 
+def embed_tokens(params: Params, tokens: jax.Array, act_sharding=None) -> jax.Array:
+    """Embedding gather with pinned shardings: gather from an explicitly
+    replicated table view, batch/seq-sharded output. The fsdp/tp-sharded
+    table would otherwise make the partitioner emit the same all-gather
+    *involuntarily* (an embed-sharded gather output it then full-remats to
+    the activation layout — "[SPMD] Involuntary full rematerialization" in
+    the multichip dryrun log); the constraint's transpose pins the bwd
+    cotangents too. The ONE copy both the sequential trunk and the
+    trainer's pipeline losses use. ``act_sharding=None`` is a plain gather.
+    """
+    emb = params["tok_emb"]
+    if act_sharding is None:
+        return emb[tokens]
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    emb = lax.with_sharding_constraint(
+        emb, NamedSharding(act_sharding.mesh, PartitionSpec())
+    )
+    return lax.with_sharding_constraint(emb[tokens], act_sharding)
+
+
 def hidden_states_with_aux(
-    params: Params, tokens: jax.Array, cfg: LlamaConfig
+    params: Params, tokens: jax.Array, cfg: LlamaConfig,
+    act_sharding=None,
 ) -> tuple[jax.Array, jax.Array]:
     """tokens [B, S] int32 -> (post-final-norm hidden [B, S, D], aux_loss).
 
     The trunk without the vocab projection: the fused CE head consumes this
     directly so the [B, S, V] logits tensor never exists on the train path.
+
+    ``act_sharding`` (a NamedSharding for [B, S, D] activations, or None)
+    pins the embedding output and the returned hidden states: without it
+    the partitioner propagates ``tok_emb``'s fsdp/tp weight sharding into
+    the gather's embed dim while downstream ops want batch/seq-sharded
+    activations, and resolves the conflict with "[SPMD] Involuntary full
+    rematerialization" all-gathers in both fwd and bwd (the constraint's
+    transpose pins the cotangents too). The trainer passes it whenever the
+    mesh has more than one device.
     """
-    x = params["tok_emb"][tokens]
+    x = embed_tokens(params, tokens, act_sharding)
     cos, sin = rope_table(cfg, tokens.shape[1])
 
     def block(carry, lp: Params):
@@ -460,7 +498,10 @@ def hidden_states_with_aux(
         block, (x, jnp.zeros((), jnp.float32)), params["layers"],
         unroll=cfg.scan_unroll,
     )
-    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux / cfg.n_layers
+    h = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if act_sharding is not None:
+        h = lax.with_sharding_constraint(h, act_sharding)
+    return h, aux / cfg.n_layers
 
 
 def forward_with_aux(
@@ -495,7 +536,8 @@ def ce_tokens(
 
 
 def loss_from_pairs(
-    params: Params, inputs: jax.Array, targets: jax.Array, cfg: LlamaConfig
+    params: Params, inputs: jax.Array, targets: jax.Array, cfg: LlamaConfig,
+    act_sharding=None,
 ) -> jax.Array:
     """Cross-entropy of predicting targets [B, S] from inputs [B, S].
 
@@ -503,8 +545,10 @@ def loss_from_pairs(
     activations, and targets, so a ``sp``-sharded seq axis stays aligned end
     to end (no off-by-one reshard between forward and loss). The head runs
     through :func:`ce_tokens` (fused chunked CE by default).
+    ``act_sharding`` pins [B, S, D] activation shardings at the trunk
+    boundaries (see :func:`hidden_states_with_aux`).
     """
-    h, aux = hidden_states_with_aux(params, inputs, cfg)
+    h, aux = hidden_states_with_aux(params, inputs, cfg, act_sharding)
     ce = jnp.mean(ce_tokens(h, params["lm_head"], targets, cfg))
     if cfg.is_moe:
         ce = ce + cfg.moe_aux_coef * aux
@@ -524,9 +568,9 @@ def train_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
 
 
 __all__ = [
-    "LlamaConfig", "init_params", "logical_axes", "forward",
+    "LlamaConfig", "embed_tokens", "init_params", "logical_axes", "forward",
     "forward_with_aux", "hidden_states_with_aux", "ce_tokens",
     "loss_fn", "loss_from_pairs",
-    "rms_norm", "rope_table", "apply_rope", "dot_attention",
+    "rms_norm", "rope_freqs", "rope_table", "apply_rope", "dot_attention",
     "transformer_block", "train_flops_per_token",
 ]

@@ -77,3 +77,80 @@ class StepTimer:
     def mfu(self, peak_flops_per_chip: float | None = None) -> float:
         peak = peak_flops_per_chip or chip_peak_flops()
         return self.tokens_per_sec_per_chip * self.flops_per_token / peak
+
+
+@dataclass
+class DecodeMetrics:
+    """Serving-side counters fed by the decode engine (serve/engine.py).
+
+    The serving counterpart of StepTimer: decode tokens/s/chip is the
+    throughput headline, time-to-first-token the latency one, and slot
+    occupancy the continuous-batching health signal (a well-fed engine
+    keeps it near 1.0; a draining or admission-starved one decays toward
+    1/slots)."""
+
+    n_chips: int = 1
+    generated_tokens: int = 0      # sampled tokens (prefill firsts + decode)
+    decode_s: float = 0.0          # wall time inside decode steps
+    prefill_s: float = 0.0         # wall time inside prefill calls
+    decode_steps: int = 0
+    occupancy_sum: float = 0.0     # sum over decode steps of live/slots
+    ttft_sum_s: float = 0.0        # submit -> first token, summed
+    ttft_max_s: float = 0.0
+    requests_started: int = 0
+    requests_finished: int = 0
+    prefill_compiles: int = 0      # distinct prefill buckets compiled
+    decode_compiles: int = 0       # distinct cache capacities compiled
+
+    def record_prefill(self, dt_s: float, ttft_s: float) -> None:
+        self.prefill_s += dt_s
+        self.ttft_sum_s += ttft_s
+        self.ttft_max_s = max(self.ttft_max_s, ttft_s)
+        self.requests_started += 1
+        self.generated_tokens += 1  # prefill samples the first token
+
+    def record_decode(self, dt_s: float, new_tokens: int, live: int,
+                      slots: int) -> None:
+        self.decode_s += dt_s
+        self.decode_steps += 1
+        self.generated_tokens += new_tokens
+        self.occupancy_sum += live / max(slots, 1)
+
+    @property
+    def elapsed_s(self) -> float:
+        return self.decode_s + self.prefill_s
+
+    @property
+    def tokens_per_sec(self) -> float:
+        if self.elapsed_s == 0:
+            return 0.0
+        return self.generated_tokens / self.elapsed_s
+
+    @property
+    def tokens_per_sec_per_chip(self) -> float:
+        return self.tokens_per_sec / self.n_chips
+
+    @property
+    def slot_occupancy(self) -> float:
+        if self.decode_steps == 0:
+            return 0.0
+        return self.occupancy_sum / self.decode_steps
+
+    @property
+    def ttft_avg_s(self) -> float:
+        if self.requests_started == 0:
+            return 0.0
+        return self.ttft_sum_s / self.requests_started
+
+    def summary(self) -> dict:
+        return {
+            "tokens_per_sec_per_chip": round(self.tokens_per_sec_per_chip, 1),
+            "generated_tokens": self.generated_tokens,
+            "ttft_avg_s": round(self.ttft_avg_s, 4),
+            "ttft_max_s": round(self.ttft_max_s, 4),
+            "slot_occupancy": round(self.slot_occupancy, 3),
+            "decode_steps": self.decode_steps,
+            "requests_finished": self.requests_finished,
+            "prefill_compiles": self.prefill_compiles,
+            "decode_compiles": self.decode_compiles,
+        }

@@ -50,8 +50,15 @@ def shard_map_compat(*args, **kwargs):
             kwargs["check_rep"] = kwargs.pop("check_vma")
         kwargs.setdefault("check_rep", False)
         if "axis_names" in kwargs:
-            manual = frozenset(kwargs.pop("axis_names"))
-            kwargs["auto"] = frozenset(kwargs["mesh"].axis_names) - manual
+            # partial-manual is not viable on this line: the old tracer
+            # lowers axis_index in a manual-with-auto region through a
+            # PartitionId instruction the SPMD partitioner rejects. Fall
+            # back to FULL manual: unmentioned axes are treated as
+            # replicated (shard_map reshards at entry), which trades the
+            # auto axes' compute sharding inside the region for
+            # correctness — acceptable on the CPU-correctness CI line;
+            # the new-jax path keeps true partial-auto.
+            kwargs.pop("axis_names")
     return fn(*args, **kwargs)
 
 
@@ -60,7 +67,36 @@ def use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def axis_size(axis_name) -> int:
+    """``lax.axis_size`` where it exists; the psum-of-1 idiom otherwise
+    (old jax constant-folds a literal psum to the axis size)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast_varying(x, axis_names):
+    """``lax.pcast(x, axes, to='varying')`` on jax lines with vma typing;
+    identity where the typing system (and pcast) doesn't exist — old
+    shard_map with check_rep off imposes no varying-axes constraints, so
+    there is nothing to cast."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, tuple(axis_names), to="varying")
+
+
+def vma_of(x) -> frozenset:
+    """The varying-mesh-axes set of ``x``'s type (empty on jax builds
+    without ``jax.typeof``)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", frozenset()) or frozenset()
+
+
 __all__ = [
-    "pallas_compiler_params", "shard_map_compat", "struct_with_vma",
-    "use_interpret",
+    "axis_size", "pallas_compiler_params", "pcast_varying",
+    "shard_map_compat", "struct_with_vma", "use_interpret", "vma_of",
 ]

@@ -1,0 +1,241 @@
+"""GQA decode attention: one query token per row against a length-aware KV
+cache, read at native ``n_kv_heads`` width.
+
+The serving hot op (docs/SERVE.md). Training-side flash attention already
+reads each K/V head ``n_heads/n_kv_heads`` times through its BlockSpec index
+map instead of materialising the repeat (ops/attention.py); the decode path
+in ``generate.py`` still ``jnp.repeat``ed the caches — 4x the HBM traffic
+AND residency at llama3's 32:8 head ratio, on an op that is pure memory
+bandwidth (one query row per request). Here queries fold to
+``[B, n_kv_heads, rep, head_dim]`` and contract against the unexpanded
+cache, and per-row ``lengths`` bound the attended positions so work stops at
+the written prefix instead of ``max_len``.
+
+Two interchangeable implementations (the ``fused_ce``/``grouped_mm``
+pattern), dispatched on ``impl``:
+
+- ``'scan'`` — ``lax.scan`` over KV blocks with an online softmax (the
+  flash recurrence). Pure XLA: runs anywhere, is the default, and keeps the
+  score transient at ``[B, Hkv, rep, block]`` instead of ``[B, H, T]``.
+- ``'pallas'`` — a TPU kernel over a ``(B * n_kv_heads, T/block)`` grid.
+  Per-row lengths ride as a scalar-prefetch argument; KV tiles entirely
+  beyond a row's length skip their FLOPs via ``pl.when`` (the DMA win comes
+  from the caller sizing the cache to the active block count — see
+  serve/cache.py). Interpreter mode on CPU.
+
+Cache layout is head-major ``[B, n_kv_heads, T, head_dim]`` (the serve
+engine's block cache flattens to exactly this), so the kernel fold is a
+reshape, not a transpose of the whole cache every step.
+
+No backward: decode is inference-only. ``T`` must be a multiple of
+``block`` (the block cache guarantees it); ``lengths`` must be >= 1 — the
+engine always writes position ``t`` before attending over ``t + 1``
+positions, so a live row's first block is never empty.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tony_tpu.ops.compat import (
+    pallas_compiler_params as _CompilerParams,
+    use_interpret as _use_interpret,
+)
+
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def reference_decode_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, lengths: jax.Array,
+    *, scale: float | None = None,
+) -> jax.Array:
+    """Repeat-expanded full-width reference (the parity oracle, and exactly
+    what generate.py's ``_cached_attention`` did per decode step).
+
+    q: [B, H, hd]; k/v: [B, Hkv, T, hd]; lengths: [B] int32 (positions
+    < lengths[b] are attended). Returns [B, H, hd].
+    """
+    B, H, hd = q.shape
+    Hkv, T = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    s = jnp.einsum("bhd,bhkd->bhk", q, k, preferred_element_type=jnp.float32)
+    valid = jnp.arange(T)[None, :] < lengths[:, None]          # [B, T]
+    s = jnp.where(valid[:, None, :], s * scale, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhk,bhkd->bhd", p, v)
+
+
+# --- scan (XLA) implementation ------------------------------------------------
+
+
+def _decode_scan(q, k, v, lengths, *, scale, block):
+    """Online-softmax scan over KV blocks, native GQA contraction."""
+    B, H, hd = q.shape
+    Hkv, T = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    nb = T // block
+    qg = q.reshape(B, Hkv, rep, hd)
+
+    m0 = jnp.full((B, Hkv, rep), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, rep), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, rep, hd), jnp.float32)
+
+    def body(carry, j):
+        m, l, acc = carry
+        kb = lax.dynamic_slice_in_dim(k, j * block, block, axis=2)
+        vb = lax.dynamic_slice_in_dim(v, j * block, block, axis=2)
+        s = jnp.einsum(
+            "bgrd,bgkd->bgrk", qg, kb, preferred_element_type=jnp.float32
+        ) * scale
+        pos = j * block + jnp.arange(block)
+        valid = pos[None, :] < lengths[:, None]                # [B, block]
+        s = jnp.where(valid[:, None, None, :], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(valid[:, None, None, :], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bgrk,bgkd->bgrd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l, acc), None
+
+    (m, l, acc), _ = lax.scan(
+        body, (m0, l0, acc0), jnp.arange(nb, dtype=jnp.int32)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(B, H, hd).astype(q.dtype)
+
+
+# --- pallas (TPU) implementation ----------------------------------------------
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc, l_sc,
+                   *, scale, block, kv_heads):
+    b, j = pl.program_id(0), pl.program_id(1)
+    nb = pl.num_programs(1)
+    row_len = len_ref[b // kv_heads]
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # tiles entirely beyond this row's written prefix contribute nothing:
+    # skip their FLOPs (their probability mass is exactly zero)
+    @pl.when(j * block < row_len)
+    def _block():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                              # [rep, block]
+        pos = j * block + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = pos < row_len
+        s = jnp.where(valid, s, _NEG)
+        m_prev = m_sc[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_sc[:, 0] = l_sc[:, 0] * corr + jnp.sum(p, axis=1)
+        acc[:] = acc[:] * corr[:, None] + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[:, 0] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[:, 0], 1e-30)
+        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k, v, lengths, *, scale, block):
+    B, H, hd = q.shape
+    Hkv, T = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    nb = T // block
+    qf = q.reshape(B * Hkv, rep, hd)
+    kf = k.reshape(B * Hkv, T, hd)
+    vf = v.reshape(B * Hkv, T, hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * Hkv, nb),
+        in_specs=[
+            pl.BlockSpec((1, rep, hd), lambda b, j, ln: (b, 0, 0)),
+            pl.BlockSpec((1, block, hd), lambda b, j, ln: (b, j, 0)),
+            pl.BlockSpec((1, block, hd), lambda b, j, ln: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rep, hd), lambda b, j, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, hd), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=scale, block=block, kv_heads=Hkv
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, rep, hd), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=_use_interpret(),
+    )(lengths.astype(jnp.int32), qf, kf, vf)
+    return out.reshape(B, H, hd)
+
+
+# --- public entry -------------------------------------------------------------
+
+
+def decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    lengths: jax.Array,
+    *,
+    impl: str = "scan",
+    block: int = 128,
+    scale: float | None = None,
+) -> jax.Array:
+    """One decode step of attention at native GQA width.
+
+    q: [B, H, head_dim] (this step's query rows); k/v: [B, Hkv, T, head_dim]
+    head-major caches (T = the active capacity, a multiple of ``block``);
+    lengths: [B] int32 — row b attends positions ``[0, lengths[b])``.
+    Returns [B, H, head_dim].
+    """
+    B, H, hd = q.shape
+    if k.shape != v.shape or k.shape[0] != B or k.shape[3] != hd:
+        raise ValueError(f"decode_attention shapes q={q.shape} k={k.shape} v={v.shape}")
+    Hkv, T = k.shape[1], k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"n_heads {H} not a multiple of n_kv_heads {Hkv}")
+    blk = min(block, T)
+    if T % blk:
+        raise ValueError(f"cache length {T} must be a multiple of block {blk}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    if impl == "pallas":
+        return _decode_pallas(q, k, v, lengths, scale=scale, block=blk)
+    if impl != "scan":
+        raise ValueError(f"unknown decode impl {impl!r} (expected scan | pallas)")
+    return _decode_scan(q, k, v, lengths, scale=scale, block=blk)
+
+
+__all__ = ["decode_attention", "reference_decode_attention"]

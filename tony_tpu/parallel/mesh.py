@@ -95,9 +95,18 @@ def inside_manual_region() -> bool:
     try:
         from jax.sharding import AxisType, get_abstract_mesh
     except ImportError:
-        # jax builds without abstract-mesh typing predate the manual-region
-        # pipeline paths entirely, so there is no region to detect
-        return False
+        # old jax (no abstract-mesh typing): shard_map binds its manual
+        # axes into the tracing axis env, so a non-empty env means we are
+        # tracing inside one (also true under pmap/named vmap — both want
+        # the region-local path here anyway). The accessor lives in
+        # jax._src.core on this line (jax.core only has a deprecation
+        # stub for it).
+        try:
+            from jax._src.core import get_axis_env
+        except ImportError:
+            return False
+        env = get_axis_env()
+        return bool(getattr(env, "axis_sizes", None))
 
     mesh = get_abstract_mesh()
     if mesh is None or not mesh.shape_tuple:

@@ -27,6 +27,13 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tony_tpu.ops.compat import (
+    axis_size as _axis_size,
+    pcast_varying as _pcast_varying,
+    shard_map_compat as _shard_map,
+    vma_of as _vma_of,
+)
+
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
@@ -50,7 +57,7 @@ def pipeline_local(
     ``(out, aux)`` where aux matches the sequential trainer's
     sum-over-layers, mean-over-batch scalar.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     M = x.shape[0]
     n_ticks = M + n_stages - 1
@@ -69,27 +76,27 @@ def pipeline_local(
         per-tick input (pp-varying): a stage_fn that scans over pp-sharded
         layer params would otherwise fail vma typing at trace time.
         """
-        xin = jax.tree.map(lambda a: lax.pcast(a, (axis_name,), to="varying"), x[0])
+        xin = jax.tree.map(lambda a: _pcast_varying(a, (axis_name,)), x[0])
         return jax.eval_shape(lambda p, b: run_stage(p, b)[0], stage_params, xin)
 
     out_shape = probe_out()
     # pcast marks the zero buffers as device-varying along the pipeline axis
     # (jax>=0.9 shard_map typing: loop carries must match the outputs, which
     # become varying after ppermute/psum).
-    recv0 = lax.pcast(
-        jnp.zeros(out_shape.shape, out_shape.dtype), (axis_name,), to="varying"
+    recv0 = _pcast_varying(
+        jnp.zeros(out_shape.shape, out_shape.dtype), (axis_name,)
     )
-    out0 = lax.pcast(
-        jnp.zeros((M, *out_shape.shape), out_shape.dtype), (axis_name,), to="varying"
+    out0 = _pcast_varying(
+        jnp.zeros((M, *out_shape.shape), out_shape.dtype), (axis_name,)
     )
-    aux0 = lax.pcast(jnp.zeros((), jnp.float32), (axis_name,), to="varying")
+    aux0 = _pcast_varying(jnp.zeros((), jnp.float32), (axis_name,))
 
     def tick(t, carry):
         recv, out, aux_acc = carry
         feed_idx = jnp.clip(t, 0, M - 1)
         first_stage_in = lax.dynamic_index_in_dim(x, feed_idx, 0, keepdims=False)
-        first_stage_in = lax.pcast(
-            first_stage_in.astype(recv.dtype), (axis_name,), to="varying"
+        first_stage_in = _pcast_varying(
+            first_stage_in.astype(recv.dtype), (axis_name,)
         )
         cur = jnp.where(my == 0, first_stage_in, recv)
         y, aux = run_stage(stage_params, cur)
@@ -133,7 +140,7 @@ def pipeline_apply(
         params = jax.tree.map(lambda a: a[0], params)  # drop unit stage dim
         return pipeline_local(stage_fn, params, xs, axis_name=axis_name)
 
-    return jax.shard_map(
+    return _shard_map(
         body,
         mesh=mesh,
         in_specs=(param_specs, P()),
@@ -227,7 +234,7 @@ def _run_1f1b(stage_params, head_params, xs, targets,
             axis_name=axis_name, n_stages=P_, M=M,
         )
 
-    return jax.shard_map(
+    return _shard_map(
         body,
         mesh=mesh,
         in_specs=(layer_specs, P(), P(), P()),
@@ -250,10 +257,9 @@ def _1f1b_local(stage_params, head_params, xs, targets, *,
 
     def vary(a):
         # idempotent: zeros_like of pp-sharded params is already varying
-        vma = getattr(jax.typeof(a), "vma", frozenset()) or frozenset()
-        if axis_name in vma:
+        if axis_name in _vma_of(a):
             return a
-        return lax.pcast(a, (axis_name,), to="varying")
+        return _pcast_varying(a, (axis_name,))
 
     xin0 = jax.tree.map(vary, xs[0])
     y_shape = jax.eval_shape(lambda p, b: stage_fn(p, b), sp_local, xin0)

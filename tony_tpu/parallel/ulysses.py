@@ -20,7 +20,7 @@ from jax import lax
 from jax.sharding import Mesh
 
 from tony_tpu.models.llama import dot_attention as _causal_attention
-from tony_tpu.ops.compat import shard_map_compat as _shard_map
+from tony_tpu.ops.compat import axis_size as _axis_size, shard_map_compat as _shard_map
 
 
 def ulysses_attention_local(
@@ -37,7 +37,7 @@ def ulysses_attention_local(
     to [B, S, H_local, D] (full sequence, heads split), runs exact attention,
     and re-shards back. ``attn(q, k, v)`` is the local attention function.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     H = q.shape[2]
     if H % n:
         raise ValueError(f"n_heads={H} not divisible by {axis_name} size {n}")

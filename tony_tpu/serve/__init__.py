@@ -1,0 +1,9 @@
+"""Serving: slot-batched continuous decoding (docs/SERVE.md)."""
+
+from tony_tpu.serve.cache import BlockKVCache, create_cache, grow_cache, shrink_cache
+from tony_tpu.serve.engine import Completion, Engine, Request, ServeConfig
+
+__all__ = [
+    "BlockKVCache", "Completion", "Engine", "Request", "ServeConfig",
+    "create_cache", "grow_cache", "shrink_cache",
+]

@@ -1,0 +1,95 @@
+"""Length-aware block KV cache for the decode engine.
+
+generate.py's original ring cache is ``[L, B, max_len, Hkv, hd]``: every
+decode step attends (and every attention DMA walks) the full ``max_len``
+buffer no matter how little of it is written, and a batch admits a request
+only by owning a whole row to ``max_len``. Here the cache is laid out in
+fixed-size **blocks** along the sequence dim and sized to the *active*
+block count:
+
+- buffers are ``[L, S, Hkv, T, hd]`` head-major (the decode kernel's native
+  layout — see ops/decode_attention.py) with ``T = n_blocks * block``;
+- ``T`` tracks ``max(ceil(lengths / block))`` over live slots, not
+  ``max_len``: attention cost and cache residency scale with what is
+  actually written (tests/test_perf_guard.py asserts the compiled decode
+  step's KV bytes scale with ``T``);
+- the engine grows ``T`` by doubling when any row fills it (bounded
+  recompiles of the decode step: one per capacity, O(log(max_len/block)))
+  and shrinks it back when the rows holding the tail finish — freed rows
+  return their blocks;
+- per-slot ``lengths`` make the cache ragged-aware: slot ``s`` has valid
+  positions ``[0, lengths[s])``; a freed slot is just ``lengths[s] = 0``
+  (its stale contents are always overwritten before the attended prefix
+  reaches them).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BlockKVCache(NamedTuple):
+    """k/v: [L, S, Hkv, T, hd] with T = n_blocks * block; lengths: [S]."""
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        """T — positions currently backed per slot."""
+        return self.k.shape[3]
+
+    @property
+    def slots(self) -> int:
+        return self.k.shape[1]
+
+
+def create_cache(
+    cfg, slots: int, n_blocks: int, block: int, dtype=None
+) -> BlockKVCache:
+    """Fresh cache with ``n_blocks`` blocks per slot."""
+    shape = (
+        cfg.n_layers, slots, cfg.n_kv_heads, n_blocks * block, cfg.head_dim
+    )
+    dt = dtype or cfg.dtype
+    return BlockKVCache(
+        jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+        jnp.zeros((slots,), jnp.int32),
+    )
+
+
+def grow_cache(cache: BlockKVCache, n_blocks: int, block: int) -> BlockKVCache:
+    """Extend every slot to ``n_blocks`` blocks (zero-filled tail)."""
+    extra = n_blocks * block - cache.capacity
+    if extra <= 0:
+        return cache
+    pad = [(0, 0), (0, 0), (0, 0), (0, extra), (0, 0)]
+    return BlockKVCache(
+        jnp.pad(cache.k, pad), jnp.pad(cache.v, pad), cache.lengths
+    )
+
+
+def shrink_cache(cache: BlockKVCache, n_blocks: int, block: int) -> BlockKVCache:
+    """Release blocks beyond ``n_blocks`` (caller guarantees no live row
+    extends past them — the engine shrinks to the live maximum)."""
+    t = n_blocks * block
+    if t >= cache.capacity:
+        return cache
+    return BlockKVCache(
+        cache.k[:, :, :, :t], cache.v[:, :, :, :t], cache.lengths
+    )
+
+
+def blocks_for(length: int, block: int) -> int:
+    """ceil(length / block), minimum 1."""
+    return max(1, math.ceil(length / block))
+
+
+__all__ = [
+    "BlockKVCache", "blocks_for", "create_cache", "grow_cache", "shrink_cache",
+]

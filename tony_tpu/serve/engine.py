@@ -1,0 +1,550 @@
+"""Slot-batched continuous decoding: the serving engine.
+
+The reference orchestrates training jobs only; serving "heavy traffic"
+(ROADMAP north star) needs an inference loop that never idles the chip.
+generate.py's old loop was the opposite of that: a static batch occupied the
+whole decode scan until its *slowest* row finished, attention walked the full
+``max_len`` cache every step, and K/V were repeat-expanded to ``n_heads``
+width. This engine replaces all three:
+
+- **Slots, not batches.** A static-shape decode batch of ``S`` slots runs
+  under ONE jitted step (static shapes, no per-request compiles). A request
+  owns a slot only while it is decoding; the moment it finishes (EOS or its
+  token budget) the slot is freed and the admission queue refills it — the
+  continuous batching of Orca/vLLM, with XLA-friendly static shapes.
+- **Bucketed prefill.** Admission pads each prompt to a small set of bucket
+  lengths, so prefill compiles once per bucket (bounded compile count), and
+  projects only the prompt's last position through ``lm_head``
+  (``forward_with_cache(last_index=...)``).
+- **Length-aware block cache + native-GQA attention.** The KV cache is the
+  block layout of serve/cache.py, sized to the active block count and read
+  by ops/decode_attention.py at native ``n_kv_heads`` width with per-slot
+  lengths — decode cost scales with what is written, not ``max_len``.
+- **Per-slot state.** Position, EOS, sampling parameters, and an rng stream
+  ride per-slot arrays inside the jitted step, so heterogeneous requests
+  (different temperatures, eos ids, budgets) share one compiled step. A
+  request's tokens depend only on its own rng key — the same request
+  submitted alone or into a busy engine samples identically
+  (tests/test_serve.py parity).
+
+Throughput/latency counters feed ``obs.metrics.DecodeMetrics`` (decode
+tokens/s/chip, TTFT, slot occupancy). docs/SERVE.md has the architecture
+notes and knob guide.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tony_tpu.models.llama import LlamaConfig, Params, rms_norm, rope_freqs
+from tony_tpu.obs.metrics import DecodeMetrics
+from tony_tpu.ops.decode_attention import decode_attention
+from tony_tpu.serve.cache import (
+    BlockKVCache, blocks_for, create_cache, grow_cache, shrink_cache,
+)
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Engine knobs (docs/SERVE.md "Knobs")."""
+
+    # concurrent decode slots (the static batch width of the jitted step)
+    slots: int = 8
+    # longest prompt+generation admitted; 0 -> model.max_seq_len
+    max_len: int = 0
+    # KV cache block size: capacity grows/shrinks in multiples of this and
+    # the decode kernel tiles the sequence by it
+    kv_block: int = 64
+    # prefill pad lengths; () -> powers of two from 16 up to max_len.
+    # Prefill compiles once per bucket (the compile-count bound).
+    prefill_buckets: tuple[int, ...] = ()
+    # decode attention kernel: 'scan' (pure XLA, default) | 'pallas'
+    # (TPU kernel, interpreted on CPU) — ops/decode_attention.py
+    decode_impl: str = "scan"
+    # static top-k slice width for sampling: per-request top_k clamps to
+    # this, and top-p-only requests use it as the bounded nucleus candidate
+    # set (generate.DEFAULT_NUCLEUS_K semantics)
+    max_top_k: int = 64
+    # release cache blocks when the live maximum drops below half the
+    # capacity (each capacity change recompiles the decode step once)
+    shrink: bool = True
+
+
+@dataclass
+class Request:
+    """One generation request (a prompt row plus sampling parameters)."""
+
+    prompt: Sequence[int] | np.ndarray | jax.Array
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    eos_id: int | None = None
+    # int seed, typed jax key, or raw uint32 key data; None -> keyed by
+    # request id (deterministic per submission order)
+    rng: Any = None
+
+
+@dataclass
+class Completion:
+    """Result of one request: generated tokens (EOS included when hit)."""
+
+    rid: int
+    tokens: list[int] = field(default_factory=list)
+    prompt_len: int = 0
+    finish_reason: str = ""  # 'eos' | 'length'
+    ttft_s: float = 0.0
+
+
+class _SlotState(NamedTuple):
+    """Per-slot device state threaded through the jitted decode step."""
+
+    last_tok: jax.Array   # [S] int32 — token to feed this step
+    rng: jax.Array        # [S, 2] uint32 — per-slot rng stream (raw keys)
+    temp: jax.Array       # [S] float32
+    top_k: jax.Array      # [S] int32
+    top_p: jax.Array      # [S] float32
+    eos: jax.Array        # [S] int32, -1 = no eos
+    done: jax.Array       # [S] bool — row has emitted eos
+    live: jax.Array       # [S] bool — slot owned by a request
+
+
+def _as_raw_key(rng: Any, rid: int) -> jnp.ndarray:
+    """Normalise a request rng (seed | typed key | raw data) to uint32[2]."""
+    if rng is None:
+        rng = rid
+    if isinstance(rng, int):
+        return jax.random.key_data(jax.random.key(rng)).astype(jnp.uint32)
+    arr = jnp.asarray(rng)
+    if jnp.issubdtype(arr.dtype, jax.dtypes.prng_key):
+        return jax.random.key_data(arr).astype(jnp.uint32)
+    return arr.astype(jnp.uint32)
+
+
+def _default_buckets(max_len: int) -> tuple[int, ...]:
+    out, b = [], 16
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+class Engine:
+    """Continuous-batching decode engine over a block KV cache.
+
+    Typical use::
+
+        engine = Engine(params, cfg, ServeConfig(slots=8))
+        rid = engine.submit(Request(prompt=..., max_new_tokens=64))
+        completions = engine.run()         # drain queue + live slots
+
+    ``submit``/``step`` can interleave (a driver can feed arrivals between
+    steps — bench.py's mixed-arrival trace does); ``run`` just steps until
+    everything drains. Single-process, one model replica; scale-out is
+    replica-per-chip above this layer.
+    """
+
+    def __init__(self, params: Params, cfg: LlamaConfig, serve: ServeConfig):
+        if cfg.is_moe:
+            # forward_with_cache (the prefill path) has no expert FFN —
+            # reject loudly instead of crashing at the first admission
+            raise NotImplementedError(
+                "serving MoE configs is not supported yet (prefill has no "
+                "expert dispatch)"
+            )
+        self.params = params
+        self.cfg = cfg
+        max_len = serve.max_len or cfg.max_seq_len
+        buckets = tuple(sorted(serve.prefill_buckets)) or _default_buckets(max_len)
+        cap = blocks_for(max_len, serve.kv_block) * serve.kv_block
+        if buckets[-1] > cap:
+            # an oversized bucket passes submit() validation but cannot be
+            # inserted into a cache capped at max_len — reject at build time
+            raise ValueError(
+                f"prefill bucket {buckets[-1]} exceeds the cache capacity "
+                f"ceiling {cap} (max_len {max_len} rounded up to kv_block)"
+            )
+        self.serve = ServeConfig(
+            slots=serve.slots, max_len=max_len, kv_block=serve.kv_block,
+            prefill_buckets=buckets, decode_impl=serve.decode_impl,
+            max_top_k=serve.max_top_k, shrink=serve.shrink,
+        )
+        S = self.serve.slots
+        try:
+            # tokens/s/chip divides by the devices actually backing the
+            # model (a sharded-params engine must not overreport per-chip)
+            n_chips = max(1, len(jax.tree.leaves(params)[0].sharding.device_set))
+        except Exception:
+            n_chips = 1
+        self.metrics = DecodeMetrics(n_chips=n_chips)
+        self.cache = create_cache(cfg, S, 1, self.serve.kv_block)
+        self.state = _SlotState(
+            last_tok=jnp.zeros((S,), jnp.int32),
+            rng=jnp.zeros((S, 2), jnp.uint32),
+            temp=jnp.zeros((S,), jnp.float32),
+            top_k=jnp.zeros((S,), jnp.int32),
+            top_p=jnp.zeros((S,), jnp.float32),
+            eos=jnp.full((S,), -1, jnp.int32),
+            done=jnp.zeros((S,), bool),
+            live=jnp.zeros((S,), bool),
+        )
+        self._queue: deque[tuple[int, Request]] = deque()
+        self._completions: dict[int, Completion] = {}
+        self._slot_rid: list[int | None] = [None] * S
+        self._slot_remaining: list[int] = [0] * S
+        self._slot_len: list[int] = [0] * S       # host mirror of lengths
+        self._submit_t: dict[int, float] = {}
+        self._next_rid = 0
+        self._prefill_fns: dict[int, Any] = {}
+        self._decode_fns: dict[int, Any] = {}
+
+    # --- public API -----------------------------------------------------------
+
+    def submit(self, req: Request) -> int:
+        """Queue a request; returns its id (the key into run()'s result)."""
+        plen = int(np.asarray(req.prompt).shape[-1])
+        if plen < 1:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {req.max_new_tokens} "
+                "(prefill always samples the first token)"
+            )
+        if plen > max(self.serve.prefill_buckets):
+            raise ValueError(
+                f"prompt length {plen} exceeds the largest prefill bucket "
+                f"{max(self.serve.prefill_buckets)}"
+            )
+        if plen + req.max_new_tokens > self.serve.max_len:
+            raise ValueError(
+                f"prompt {plen} + max_new_tokens {req.max_new_tokens} "
+                f"exceeds max_len {self.serve.max_len}"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append((rid, req))
+        self._submit_t[rid] = time.perf_counter()
+        return rid
+
+    @property
+    def n_live(self) -> int:
+        return sum(1 for r in self._slot_rid if r is not None)
+
+    def reset_metrics(self) -> None:
+        """Fresh throughput/latency counters (e.g. after a warmup trace
+        that paid the compiles); compile counts persist — they describe
+        the engine, not the trace."""
+        self.metrics = DecodeMetrics(
+            n_chips=self.metrics.n_chips,
+            prefill_compiles=len(self._prefill_fns),
+            decode_compiles=len(self._decode_fns),
+        )
+
+    def step(self) -> int:
+        """Admit what fits, run one decode step; returns live-slot count."""
+        self._admit()
+        if self.n_live:
+            self._decode_once()
+        return self.n_live
+
+    def run(self, requests: Sequence[Request] | None = None) -> dict[int, Completion]:
+        """Submit ``requests`` (if given), drain queue and live slots, and
+        return — and evict — every completion finished by this call (a
+        long-lived engine must not accumulate one Completion per request
+        forever; callers keep what run() hands them)."""
+        if requests is not None:
+            for r in requests:
+                self.submit(r)
+        while self._queue or self.n_live:
+            self.step()
+        done, self._completions = self._completions, {}
+        return done
+
+    # --- admission ------------------------------------------------------------
+
+    def _admit(self) -> None:
+        free = [s for s, r in enumerate(self._slot_rid) if r is None]
+        while free and self._queue:
+            self._admit_one(free.pop(0), *self._queue.popleft())
+
+    def _bucket_for(self, plen: int) -> int:
+        for b in self.serve.prefill_buckets:
+            if b >= plen:
+                return b
+        raise AssertionError("submit() validated bucket coverage")
+
+    def _admit_one(self, slot: int, rid: int, req: Request) -> None:
+        t0 = time.perf_counter()
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        plen = len(prompt)
+        bucket = self._bucket_for(plen)
+        self._ensure_capacity(max(bucket, plen + 1))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = prompt
+        key = _as_raw_key(req.rng, rid)
+        tok, carry, pk, pv = self._get_prefill(bucket)(
+            self.params, jnp.asarray(padded), jnp.int32(plen - 1),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p), key,
+        )
+        tok = int(np.asarray(tok))
+        now = time.perf_counter()
+        self.metrics.record_prefill(now - t0, now - self._submit_t[rid])  # popped below
+
+        self.cache = _insert_fn()(
+            self.cache, pk, pv, jnp.int32(slot), jnp.int32(plen)
+        )
+        self._slot_len[slot] = plen
+        st = self.state
+        eos = -1 if req.eos_id is None else int(req.eos_id)
+        self.state = _SlotState(
+            last_tok=st.last_tok.at[slot].set(tok),
+            rng=st.rng.at[slot].set(carry),
+            temp=st.temp.at[slot].set(req.temperature),
+            top_k=st.top_k.at[slot].set(req.top_k),
+            top_p=st.top_p.at[slot].set(req.top_p),
+            eos=st.eos.at[slot].set(eos),
+            done=st.done.at[slot].set(False),
+            live=st.live.at[slot].set(True),
+        )
+        self._slot_rid[slot] = rid
+        self._slot_remaining[slot] = req.max_new_tokens
+        comp = Completion(
+            rid=rid, tokens=[tok], prompt_len=plen,
+            ttft_s=now - self._submit_t.pop(rid),
+        )
+        self._completions[rid] = comp
+        self._slot_remaining[slot] -= 1
+        if tok == eos:
+            self._finish(slot, "eos")
+        elif self._slot_remaining[slot] <= 0:
+            self._finish(slot, "length")
+
+    def _finish(self, slot: int, reason: str) -> None:
+        rid = self._slot_rid[slot]
+        self._completions[rid].finish_reason = reason
+        self.metrics.requests_finished += 1
+        self._slot_rid[slot] = None
+        self._slot_remaining[slot] = 0
+        self._slot_len[slot] = 0
+        st = self.state
+        self.state = st._replace(
+            live=st.live.at[slot].set(False),
+            done=st.done.at[slot].set(False),
+        )
+        self.cache = self.cache._replace(
+            lengths=self.cache.lengths.at[slot].set(0)
+        )
+
+    # --- capacity -------------------------------------------------------------
+
+    def _ensure_capacity(self, min_positions: int) -> None:
+        """Grow (doubling) so every live row + ``min_positions`` fits; shrink
+        when the live maximum has fallen to half the capacity or less."""
+        block = self.serve.kv_block
+        live_max = max(
+            [min_positions]
+            + [self._slot_len[s] + 1 for s, r in enumerate(self._slot_rid) if r is not None]
+        )
+        need = blocks_for(live_max, block)
+        cap_blocks = blocks_for(self.serve.max_len, block)
+        cur = self.cache.capacity // block
+        if need > cur:
+            new = min(max(need, 2 * cur), cap_blocks)
+            self.cache = grow_cache(self.cache, new, block)
+        elif self.serve.shrink and need <= cur // 2:
+            self.cache = shrink_cache(self.cache, need, block)
+
+    # --- jitted steps ---------------------------------------------------------
+
+    def _get_prefill(self, bucket: int):
+        if bucket not in self._prefill_fns:
+            self._prefill_fns[bucket] = _prefill_fn(
+                self.cfg, bucket, self.serve.max_top_k
+            )
+            self.metrics.prefill_compiles = len(self._prefill_fns)
+        return self._prefill_fns[bucket]
+
+    def _get_decode(self, capacity: int):
+        if capacity not in self._decode_fns:
+            # ONE jitted wrapper per (model, kernel) config, shared across
+            # engines module-wide (jit caches per argument shape, so every
+            # capacity/slot-count signature compiles once per process, not
+            # once per Engine); the per-engine dict only counts the
+            # distinct capacities this engine entered
+            self._decode_fns[capacity] = _decode_fn(
+                self.cfg, self.serve.decode_impl, self.serve.kv_block,
+                self.serve.max_top_k,
+            )
+            self.metrics.decode_compiles = len(self._decode_fns)
+        return self._decode_fns[capacity]
+
+    # --- decode loop ----------------------------------------------------------
+
+    def _decode_once(self) -> None:
+        self._ensure_capacity(1)
+        live_before = [s for s, r in enumerate(self._slot_rid) if r is not None]
+        t0 = time.perf_counter()
+        self.cache, self.state, toks = self._get_decode(self.cache.capacity)(
+            self.params, self.cache, self.state
+        )
+        toks_np = np.asarray(toks)
+        done_np = np.asarray(self.state.done)
+        dt = time.perf_counter() - t0
+        self.metrics.record_decode(
+            dt, len(live_before), len(live_before), self.serve.slots
+        )
+        for s in live_before:
+            self._slot_len[s] += 1
+            self._completions[self._slot_rid[s]].tokens.append(int(toks_np[s]))
+            self._slot_remaining[s] -= 1
+            if done_np[s]:
+                self._finish(s, "eos")
+            elif self._slot_remaining[s] <= 0:
+                self._finish(s, "length")
+
+    def _decode_impl(self, params, cache: BlockKVCache, state: _SlotState):
+        """One token for every slot (test/guard hook; the hot path goes
+        through the module-level cache in :func:`_decode_fn`)."""
+        return _decode_step(
+            params, cache, state, cfg=self.cfg,
+            decode_impl=self.serve.decode_impl,
+            kv_block=self.serve.kv_block, max_top_k=self.serve.max_top_k,
+        )
+
+
+@functools.lru_cache(maxsize=512)
+def _prefill_fn(cfg: LlamaConfig, bucket: int, max_top_k: int):
+    """Jitted bucketed prefill, cached per (model config, bucket): engines
+    with the same model share prefill compiles process-wide."""
+    return jax.jit(partial(
+        _prefill_step, cfg=cfg, bucket=bucket, max_top_k=max_top_k
+    ))
+
+
+@functools.lru_cache(maxsize=512)
+def _decode_fn(cfg: LlamaConfig, decode_impl: str, kv_block: int,
+               max_top_k: int):
+    """Jitted decode step, cached per (model config, kernel knobs) — NOT
+    per capacity/slots: jit itself caches per argument shape, so all
+    engines with the same model reuse every compiled signature."""
+    return jax.jit(
+        partial(
+            _decode_step, cfg=cfg, decode_impl=decode_impl,
+            kv_block=kv_block, max_top_k=max_top_k,
+        ),
+        donate_argnums=(1, 2),
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def _insert_fn():
+    """Jitted prefill-KV insert with a DONATED cache: the un-jitted
+    ``.at[...].set`` form dispatched two whole-cache device copies per
+    admission (the old buffers stay referenced, so XLA cannot update in
+    place) — O(cache) instead of O(bucket) work every admit."""
+    def insert(cache: BlockKVCache, pk, pv, slot, plen):
+        k = lax.dynamic_update_slice(cache.k, pk[:, None], (0, slot, 0, 0, 0))
+        v = lax.dynamic_update_slice(cache.v, pv[:, None], (0, slot, 0, 0, 0))
+        lengths = lax.dynamic_update_slice(
+            cache.lengths, plen[None], (slot,)
+        )
+        return BlockKVCache(k, v, lengths)
+
+    return jax.jit(insert, donate_argnums=(0,))
+
+
+def _prefill_step(params, prompt, last_index, temp, top_k, top_p, key, *,
+                  cfg: LlamaConfig, bucket: int, max_top_k: int):
+    from tony_tpu.models.generate import (
+        KVCache, forward_with_cache, sample_tokens,
+    )
+
+    cache0 = KVCache.create(cfg, 1, bucket)
+    logits, kv = forward_with_cache(
+        params, prompt, cache0, jnp.int32(0), cfg, last_index=last_index
+    )
+    use, carry = jax.random.split(key)
+    tok = sample_tokens(
+        logits[:, 0], temp[None], top_k[None], top_p[None], use[None],
+        max_k=max_top_k,
+    )[0]
+    # [L, 1, bucket, Hkv, hd] -> head-major [L, Hkv, bucket, hd]
+    pk = kv.k[:, 0].transpose(0, 2, 1, 3)
+    pv = kv.v[:, 0].transpose(0, 2, 1, 3)
+    return tok, carry, pk, pv
+
+
+def _decode_step(params, cache: BlockKVCache, state: _SlotState, *,
+                 cfg: LlamaConfig, decode_impl: str, kv_block: int,
+                 max_top_k: int):
+    """One token for every slot: write K/V at each row's position, attend
+    over its written prefix, sample with its own stream."""
+    from tony_tpu.models.generate import sample_tokens
+
+    S = state.last_tok.shape[0]
+    hd, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    x = params["tok_emb"][state.last_tok]                  # [S, D]
+    pos = cache.lengths                                    # [S]
+    ang = pos.astype(jnp.float32)[:, None] * rope_freqs(cfg)[None, :]
+    cos = jnp.cos(ang)[:, None, :]                         # [S, 1, half]
+    sin = jnp.sin(ang)[:, None, :]
+
+    def rope(t):  # [S, H', hd], per-row angle
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [t1 * cos - t2 * sin, t2 * cos + t1 * sin], axis=-1
+        ).astype(t.dtype)
+
+    def write(c, new, p):  # c [Hkv, T, hd], new [Hkv, hd], p scalar
+        return lax.dynamic_update_slice(c, new[:, None, :], (0, p, 0))
+
+    def block(x, layer):
+        lp, k_cache, v_cache = layer
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = rope((h @ lp["wq"]).reshape(S, H, hd))
+        k_new = rope((h @ lp["wk"]).reshape(S, Hkv, hd))
+        v_new = (h @ lp["wv"]).reshape(S, Hkv, hd)
+        k_cache = jax.vmap(write)(k_cache, k_new, pos)
+        v_cache = jax.vmap(write)(v_cache, v_new, pos)
+        attn = decode_attention(
+            q, k_cache, v_cache, pos + 1,
+            impl=decode_impl, block=kv_block,
+        )
+        x = x + attn.reshape(S, H * hd) @ lp["wo"]
+        h2 = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        delta = (jax.nn.silu(h2 @ lp["w1"]) * (h2 @ lp["w3"])) @ lp["w2"]
+        return x + delta, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(
+        block, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)   # [S, V]
+
+    both = jax.vmap(jax.random.split)(state.rng)           # [S, 2, 2]
+    nxt = sample_tokens(
+        logits, state.temp, state.top_k, state.top_p, both[:, 0],
+        max_k=max_top_k,
+    )
+    has_eos = state.eos >= 0
+    nxt = jnp.where(state.done & has_eos, state.eos, nxt)
+    done = state.done | (has_eos & (nxt == state.eos))
+    lengths = cache.lengths + state.live.astype(jnp.int32)
+    new_state = state._replace(last_tok=nxt, rng=both[:, 1], done=done)
+    return BlockKVCache(new_k, new_v, lengths), new_state, nxt
+
+
+
+__all__ = ["Completion", "Engine", "Request", "ServeConfig"]

@@ -20,9 +20,23 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tony_tpu.models import llama
+from tony_tpu.ops.compat import pcast_varying as _pcast_varying, shard_map_compat as _shard_map
 from tony_tpu.parallel.sharding import DEFAULT_RULES, Rules, spec_for, tree_shardings
 
 Params = dict[str, Any]
+
+
+def _ensure_partitionable_threefry() -> None:
+    """Partitionable threefry makes jax.random values independent of the
+    mesh/sharding they are generated under (the default on current jax
+    lines; old 0.4.x defaults to False, under which make_train_state's
+    jit-sharded init produced DIFFERENT params per mesh — a pp mesh and
+    its sequential reference trained two different models, and
+    schedule-parity could only fail). Flipped at the trainer entrypoints
+    rather than at import so merely importing configs doesn't mutate
+    process-global RNG semantics."""
+    if not jax.config.jax_threefry_partitionable:
+        jax.config.update("jax_threefry_partitionable", True)
 
 
 @jax.tree_util.register_dataclass
@@ -114,6 +128,7 @@ def make_train_state(
 ) -> TrainState:
     """Initialise the TrainState directly sharded (no host-side full copy --
     required for models that don't fit one host/chip)."""
+    _ensure_partitionable_threefry()
     shardings = state_shardings(cfg, mesh, optimizer, rules)
 
     def init(rng: jax.Array) -> TrainState:
@@ -148,6 +163,7 @@ def make_train_step(
     must map "layers" to "pp" (fit() does this automatically;
     :func:`pp_rules` applies the override).
     """
+    _ensure_partitionable_threefry()
     if pp_schedule not in ("gpipe", "1f1b"):
         # validate even on pp=1 meshes: a typo'd schedule must fail loudly,
         # not silently run the sequential loss
@@ -155,15 +171,29 @@ def make_train_step(
             f"unknown pp_schedule {pp_schedule!r} (expected gpipe | 1f1b)"
         )
     pp = int(mesh.shape.get("pp", 1))
+    # pin [B, S, D] activations to the canonical batch/seq sharding at the
+    # trunk boundaries: without the constraint the partitioner propagates
+    # the fsdp/tp weight shardings into the embedding gather / loss-head
+    # reshape and resolves the conflict with involuntary full-remat
+    # all-gathers (fwd AND bwd — the constraint's transpose pins the
+    # cotangents), visible as "[SPMD] Involuntary full rematerialization"
+    # warnings in the multichip dryrun log
+    act_sharding = (
+        NamedSharding(mesh, spec_for(("batch", "seq", "embed"), rules))
+        if mesh.size > 1 else None
+    )
     if pp > 1:
         rules = pp_rules(rules)
         pp_loss = pp_loss_from_pairs if pp_schedule == "gpipe" else pp_1f1b_loss_from_pairs
         loss_fn = partial(
             pp_loss, cfg=cfg, mesh=mesh,
             n_microbatches=n_microbatches or 2 * pp,
+            act_sharding=act_sharding,
         )
     else:
-        loss_fn = partial(llama.loss_from_pairs, cfg=cfg)
+        loss_fn = partial(
+            llama.loss_from_pairs, cfg=cfg, act_sharding=act_sharding
+        )
     shardings = state_shardings(cfg, mesh, optimizer, rules)
     batch_sharding = NamedSharding(mesh, spec_for(("batch", "seq"), rules))
     replicated = NamedSharding(mesh, P())
@@ -187,6 +217,7 @@ def make_train_step(
 def pp_1f1b_loss_from_pairs(
     params: Params, inputs: jax.Array, targets: jax.Array, *,
     cfg: llama.LlamaConfig, mesh: Mesh, n_microbatches: int,
+    act_sharding=None,
 ) -> jax.Array:
     """1F1B pipeline loss: same stage decomposition as the GPipe loss, but
     the backward is hand-scheduled (parallel.pipeline.pipeline_train_1f1b)
@@ -203,7 +234,7 @@ def pp_1f1b_loss_from_pairs(
         )
     _pp_guard(cfg, mesh)
 
-    x = params["tok_emb"][inputs]
+    x = llama.embed_tokens(params, inputs, act_sharding)
     cos, sin = llama.rope_table(cfg, inputs.shape[1])
     xs = microbatch(x, n_microbatches)
     tgts = microbatch(targets, n_microbatches)
@@ -262,7 +293,7 @@ def _pp_stage_fn(cfg: llama.LlamaConfig, cos: jax.Array, sin: jax.Array):
                 blk, policy=jax.checkpoint_policies.nothing_saveable
             )
         # the aux carry must be pp-varying like the stage's layer params
-        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
+        aux0 = _pcast_varying(jnp.zeros((), jnp.float32), ("pp",))
         (y, aux), _ = jax.lax.scan(blk, (mb, aux0), lp_stack)
         return y, aux
 
@@ -278,6 +309,7 @@ def pp_rules(rules: Rules = DEFAULT_RULES) -> Rules:
 def pp_loss_from_pairs(
     params: Params, inputs: jax.Array, targets: jax.Array, *,
     cfg: llama.LlamaConfig, mesh: Mesh, n_microbatches: int,
+    act_sharding=None,
 ) -> jax.Array:
     """GPipe pipeline loss: embedding and head run auto-sharded outside the
     pipeline; the layer stack runs as pp stages under a shard_map that is
@@ -290,7 +322,7 @@ def pp_loss_from_pairs(
 
     _pp_guard(cfg, mesh)
 
-    x = params["tok_emb"][inputs]
+    x = llama.embed_tokens(params, inputs, act_sharding)
     cos, sin = llama.rope_table(cfg, inputs.shape[1])
     xs = microbatch(x, n_microbatches)  # [M, mb, S, D]
 
@@ -301,7 +333,7 @@ def pp_loss_from_pairs(
         )
 
     layer_specs = jax.tree.map(lambda _: P("pp"), params["layers"])
-    h, aux = jax.shard_map(
+    h, aux = _shard_map(
         body,
         mesh=mesh,
         in_specs=(layer_specs, P(), P(), P()),
@@ -309,6 +341,10 @@ def pp_loss_from_pairs(
         axis_names={"pp"},  # manual over pp; all other axes stay auto
     )(params["layers"], xs, cos, sin)
     h = unmicrobatch(h)
+    if act_sharding is not None:
+        # the CE head mixes h with batch-sharded targets; pin h to the same
+        # layout so the partitioner doesn't invent a reshard
+        h = jax.lax.with_sharding_constraint(h, act_sharding)
 
     ce = _ce_head(params["final_norm"], params["lm_head"], h, targets, cfg)
     if cfg.is_moe:
