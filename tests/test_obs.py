@@ -1,16 +1,20 @@
-"""Tests for portal, proxy, auth, and the metrics pipeline."""
+"""Tests for portal, proxy, auth, the metrics pipeline, and the trace spine."""
 
 import json
+import math
 import os
 import socket
 import threading
+import time
 import urllib.request
 
 import grpc
 import pytest
 
+from tony_tpu.obs import trace
 from tony_tpu.obs.portal import PortalData, serve_portal
 from tony_tpu.obs.proxy import ProxyServer
+from tony_tpu.obs.registry import Registry, render_snapshots, write_snapshot
 from tony_tpu.rpc import ApplicationRpcClient, ApplicationRpcServicer, pb, serve
 from tony_tpu.rpc.auth import mint_token, read_token
 
@@ -211,3 +215,462 @@ def test_diagnostics_context(monkeypatch, tmp_path):
     )
     env = make_runtime("generic").build_env(ident, cfg)
     assert env.get("TONY_TPU_DIAGNOSTICS") == "1"
+
+
+# --- the distributed trace spine (obs/trace.py; docs/OBS.md) -----------------
+
+
+@pytest.fixture
+def armed_tracer(tmp_path):
+    """A real tracer armed process-globally, always disarmed afterwards."""
+    tracer = trace.Tracer(
+        str(tmp_path / "trace" / "test_proc.jsonl"), "test_proc", "trace01",
+        sample_steps=4, flush_interval_s=0.05,
+    )
+    trace.install(tracer)
+    try:
+        yield tracer
+    finally:
+        trace.uninstall()
+
+
+def read_journal(path):
+    recs = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                recs.append(json.loads(line))
+    return recs
+
+
+class TestTraceSpine:
+    def test_span_lifecycle_and_nesting(self, tmp_path, armed_tracer):
+        """Context-managed spans nest per thread (child's psid == parent's
+        sid), manual spans end explicitly, instants are zero-duration, and
+        everything journals with wall-anchored monotonic timestamps."""
+        t_before = time.time() * 1e6
+        with trace.span("outer", phase="x") as outer:
+            with trace.span("inner") as inner:
+                time.sleep(0.01)
+            trace.instant("marker", why="test")
+        manual = armed_tracer.span("manual")
+        time.sleep(0.002)
+        manual.end(result="done")
+        trace.uninstall()
+        recs = read_journal(tmp_path / "trace" / "test_proc.jsonl")
+        meta = recs[0]
+        assert meta["ph"] == "M" and meta["proc"] == "test_proc"
+        assert meta["trace"] == "trace01"
+        by_name = {r["name"]: r for r in recs if r["ph"] == "X"}
+        assert set(by_name) == {"outer", "inner", "manual"}
+        # parent/child: inner under outer; manual is a root
+        assert by_name["inner"]["psid"] == outer.sid
+        assert by_name["outer"]["sid"] == outer.sid
+        assert by_name["outer"]["psid"] == ""
+        assert by_name["manual"]["psid"] == ""
+        assert by_name["manual"]["args"]["result"] == "done"
+        # timing: inner inside outer, durations sane, wall-anchored
+        o, i = by_name["outer"], by_name["inner"]
+        assert o["ts"] <= i["ts"] and i["dur"] >= 10_000
+        assert o["dur"] >= i["dur"]
+        assert o["ts"] >= t_before - 5e6
+        inst = [r for r in recs if r["ph"] == "i"]
+        assert len(inst) == 1 and inst[0]["name"] == "marker"
+        assert o["ts"] <= inst[0]["ts"] <= o["ts"] + o["dur"]
+
+    def test_rpc_hop_propagates_context(self, armed_tracer, tmp_path):
+        """The cross-process edge: a client span's id rides gRPC metadata
+        and the server's dispatch span parents on it (client and server
+        share one armed tracer here, so both sides land in one journal)."""
+
+        class S(ApplicationRpcServicer):
+            def Heartbeat(self, request, context):
+                return pb.HeartbeatResponse()
+
+        server, port = serve(S(), port=0)
+        try:
+            with ApplicationRpcClient(f"127.0.0.1:{port}") as client:
+                with trace.span("caller"):
+                    client.heartbeat("w", 0)
+        finally:
+            server.stop(0)
+        trace.uninstall()
+        recs = read_journal(tmp_path / "trace" / "test_proc.jsonl")
+        by_name = {r["name"]: r for r in recs if r["ph"] == "X"}
+        caller = by_name["caller"]
+        cl = by_name["rpc.client/Heartbeat"]
+        sv = by_name["rpc.server/Heartbeat"]
+        assert cl["psid"] == caller["sid"]
+        assert sv["psid"] == cl["sid"]  # crossed the wire via metadata
+        assert sv["args"]["method"] == "Heartbeat"
+
+    def test_disarmed_span_is_inert(self):
+        assert trace.active_tracer() is None
+        sp = trace.span("anything", k=1)
+        assert sp is trace.NOOP_SPAN
+        with sp:
+            pass
+        sp.end()
+        trace.instant("nothing")
+        trace.flush()
+
+    def test_journal_rotation_keeps_newest(self, tmp_path):
+        """At the size cap the journal rotates (flight-recorder retention):
+        the NEWEST events survive — a post-mortem needs the crash window,
+        not day one — disk stays bounded at two windows, and load_journals
+        merges the rotated window back into one process entry."""
+        from tony_tpu.obs.trace_tool import load_journals
+
+        tracer = trace.Tracer(
+            str(tmp_path / "trace" / "rot.jsonl"), "rot", "t",
+            flush_interval_s=999.0,
+        )
+        tracer._max_bytes = 4096
+        for i in range(200):
+            tracer.span(f"s{i:04d}").end()
+        tracer.close()
+        files = sorted(os.listdir(tmp_path / "trace"))
+        assert files == ["rot.0.jsonl", "rot.jsonl"]
+        procs = load_journals(str(tmp_path / "trace"))
+        assert len(procs) == 1 and procs[0]["proc"] == "rot"
+        names = {s["name"] for s in procs[0]["spans"]}
+        assert "s0199" in names      # the crash window survived
+        assert "s0000" not in names  # the oldest window was dropped
+        # append-mode reopen (re-arm cycle / relaunch reusing the proc
+        # name) must count the existing bytes or the disk bound breaks
+        existing = os.path.getsize(tmp_path / "trace" / "rot.jsonl")
+        assert existing > 0
+        tracer2 = trace.Tracer(
+            str(tmp_path / "trace" / "rot.jsonl"), "rot", "t",
+            flush_interval_s=999.0,
+        )
+        assert tracer2._written >= existing
+        tracer2.close()
+
+    def test_emergency_flush_journals_open_spans(self, tmp_path, armed_tracer):
+        """The pre-SIGKILL path: spans still open when a chaos kill fires
+        are journaled as begin-only records with an ``fts`` kill-time proxy
+        (they are what the fault interrupted), and merge_chrome renders
+        them as Chrome B events."""
+        from tony_tpu.obs.trace_tool import load_journals, merge_chrome
+
+        killed = armed_tracer.span("outer.killed")  # never ends: the SIGKILL
+        trace.emergency_flush()  # what chaos does right before the kill
+        procs = load_journals(str(tmp_path / "trace"))
+        assert procs[0]["opens"], "open span missing from emergency flush"
+        o = procs[0]["opens"][0]
+        assert o["name"] == "outer.killed" and o["fts"] >= o["ts"]
+        merged = merge_chrome(str(tmp_path), procs)
+        b = next(e for e in merged["traceEvents"] if e["ph"] == "B")
+        assert b["name"] == "outer.killed" and b["args"]["killed"] is True
+        # a fault the process SURVIVES: the span completes, and the merge
+        # keeps only the finished X record (no duplicate begin-only ghost)
+        killed.end()
+        armed_tracer.flush()
+        procs = load_journals(str(tmp_path / "trace"))
+        assert not procs[0]["opens"]
+        assert any(s["name"] == "outer.killed" for s in procs[0]["spans"])
+
+    def test_close_journals_open_spans(self, tmp_path):
+        """Normal shutdown rescues un-ended spans too: a root span whose
+        holder was unwound by an exception (Ctrl-C'd supervise loop) must
+        not vanish from the merge — close() journals it begin-only, once."""
+        from tony_tpu.obs.trace_tool import load_journals
+
+        tracer = trace.Tracer(
+            str(tmp_path / "trace" / "am.jsonl"), "am", "t",
+            flush_interval_s=999.0,
+        )
+        tracer.span("am.run", attempt=0)  # never .end(): interrupted
+        tracer.close()
+        procs = load_journals(str(tmp_path / "trace"))
+        opens = procs[0]["opens"]
+        assert [o["name"] for o in opens] == ["am.run"]
+        assert opens[0]["fts"] >= opens[0]["ts"]
+
+    def test_env_arming_roundtrip(self, tmp_path, monkeypatch):
+        """install_from_env arms from the AM-exported contract and the
+        default parent roots this process under the launcher's span."""
+        monkeypatch.setenv(trace.ENV_DIR, str(tmp_path / "trace"))
+        monkeypatch.setenv(trace.ENV_TRACE_ID, "abcd")
+        monkeypatch.setenv(trace.ENV_PROC, "worker_0_user_a0")
+        monkeypatch.setenv(trace.ENV_PARENT, "feedbeef")
+        monkeypatch.setenv(trace.ENV_SAMPLE, "8")
+        monkeypatch.setenv(trace.ENV_RING, "128")
+        monkeypatch.setenv(trace.ENV_JOURNAL_MB, "7")
+        tracer = trace.install_from_env()
+        try:
+            assert tracer is not None
+            assert tracer.trace_id == "abcd" and tracer.sample_steps == 8
+            assert tracer.ring_size == 128 and tracer.max_journal_mb == 7
+            with trace.span("root_here"):
+                pass
+        finally:
+            trace.uninstall()
+        recs = read_journal(tmp_path / "trace" / "worker_0_user_a0.jsonl")
+        root = next(r for r in recs if r.get("name") == "root_here")
+        assert root["psid"] == "feedbeef"
+
+
+class TestRegistry:
+    def test_prometheus_exposition_conformance(self):
+        """TYPE lines, histogram bucket monotonicity + cumulative le
+        semantics, _sum/_count agreement, label rendering."""
+        reg = Registry()
+        c = reg.counter("tony_test_total", "a counter", method="Beat")
+        c.inc(); c.inc(2)
+        g = reg.gauge("tony_test_depth", "a gauge")
+        g.set(7)
+        h = reg.histogram("tony_ttft_seconds", "ttft")
+        for v in (0.002, 0.002, 0.03, 0.2, 4.0, 100.0):
+            h.observe(v)
+        text = reg.render()
+        lines = text.strip().splitlines()
+        assert "# TYPE tony_test_total counter" in lines
+        assert "# TYPE tony_test_depth gauge" in lines
+        assert "# TYPE tony_ttft_seconds histogram" in lines
+        assert 'tony_test_total{method="Beat"} 3' in lines
+        assert "tony_test_depth 7" in lines
+        # HELP precedes TYPE for each family
+        for name in ("tony_test_total", "tony_ttft_seconds"):
+            assert lines.index(f"# HELP {name} " + dict(
+                tony_test_total="a counter", tony_ttft_seconds="ttft",
+            )[name]) < lines.index([l for l in lines if l.startswith(f"# TYPE {name}")][0])
+        # bucket counts are cumulative and monotonic, +Inf == _count
+        buckets = []
+        for line in lines:
+            if line.startswith("tony_ttft_seconds_bucket"):
+                le = line.split('le="')[1].split('"')[0]
+                buckets.append((le, int(line.rsplit(" ", 1)[1])))
+        assert buckets[-1][0] == "+Inf"
+        counts = [b[1] for b in buckets]
+        assert counts == sorted(counts)  # monotone
+        count_line = next(l for l in lines if l.startswith("tony_ttft_seconds_count"))
+        assert int(count_line.rsplit(" ", 1)[1]) == 6 == counts[-1]
+        sum_line = next(l for l in lines if l.startswith("tony_ttft_seconds_sum"))
+        assert math.isclose(float(sum_line.rsplit(" ", 1)[1]), 104.234)
+        # bucketed quantiles are ordered and bracket the data
+        assert 0 < h.quantile(0.5) <= h.quantile(0.95) <= h.quantile(0.99)
+
+    def test_portal_metrics_endpoint(self, tmp_path):
+        """The portal /metrics endpoint re-renders every app's registry
+        snapshots as one labelled Prometheus scrape."""
+        reg = Registry()
+        reg.histogram("tony_step_time_seconds", "step time").observe(0.12)
+        reg.histogram("tony_ttft_seconds", "ttft").observe(0.05)
+        app_dir = tmp_path / "job-metrics"
+        (app_dir / "metrics").mkdir(parents=True)
+        write_snapshot(
+            str(app_dir / "metrics" / "worker_0_user.json"), reg,
+            proc="worker_0_user",
+        )
+        server, port = serve_portal(str(tmp_path), port=0, host="127.0.0.1")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                body = r.read().decode()
+        finally:
+            server.shutdown()
+        assert "# TYPE tony_step_time_seconds histogram" in body
+        assert "# TYPE tony_ttft_seconds histogram" in body
+        assert 'app="job-metrics"' in body and 'proc="worker_0_user"' in body
+        assert 'le="+Inf"' in body
+        # render_snapshots merges multiple snapshots under one TYPE header
+        snaps = PortalData(str(tmp_path)).metric_snapshots()
+        text = render_snapshots(snaps + snaps)
+        assert text.count("# TYPE tony_step_time_seconds histogram") == 1
+
+    def test_render_skips_malformed_entries(self):
+        """One malformed snapshot entry (older format, hand-edited file)
+        must not take down the fleet-wide scrape."""
+        good = {"kind": "gauge", "name": "tony_ok", "help": "", "labels": {},
+                "value": 1.0}
+        text = render_snapshots([({}, [
+            None, 42, {"no": "name"},
+            {"kind": "histogram", "name": "tony_broken", "labels": {}},  # no bounds
+            good,
+        ])])
+        assert "tony_ok 1" in text
+        assert "tony_broken_bucket" not in text
+
+
+class TestTraceMerge:
+    def _write_journal(self, trace_dir, proc, pid, recs):
+        trace_dir.mkdir(parents=True, exist_ok=True)
+        with open(trace_dir / f"{proc}.jsonl", "w") as f:
+            f.write(json.dumps({"ph": "M", "proc": proc, "pid": pid,
+                                "trace": "t"}) + "\n")
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    def test_merge_emits_valid_chrome_trace(self, tmp_path):
+        from tony_tpu.obs.trace_tool import merge_chrome
+
+        tdir = tmp_path / "trace"
+        self._write_journal(tdir, "am_a0", 100, [
+            {"ph": "X", "name": "am.run", "ts": 1_000_000, "dur": 5_000_000,
+             "tid": 1, "sid": "a", "psid": "", "args": {}},
+        ])
+        self._write_journal(tdir, "worker_0_exec_a0", 200, [
+            {"ph": "X", "name": "executor.register", "ts": 1_200_000,
+             "dur": 10_000, "tid": 2, "sid": "b", "psid": "a", "args": {}},
+            {"ph": "i", "name": "chaos.drop_heartbeats", "ts": 2_000_000,
+             "tid": 2, "args": {"point": "executor.beat"}},
+        ])
+        # a torn tail (SIGKILLed writer) must be skipped, not fatal
+        with open(tdir / "worker_0_exec_a0.jsonl", "a") as f:
+            f.write('{"ph": "X", "name": "torn')
+        merged = merge_chrome(str(tmp_path))
+        events = merged["traceEvents"]
+        json.dumps(merged)  # serializable end-to-end
+        pids = {e["pid"] for e in events}
+        assert len(pids) == 2
+        names = {e["pid"]: e["args"]["name"] for e in events
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert set(names.values()) == {"am_a0", "worker_0_exec_a0"}
+        for e in events:
+            assert e["ph"] in ("M", "X", "i")
+            if e["ph"] == "X":
+                assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        xs = [e for e in events if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"am.run", "executor.register"}
+        inst = [e for e in events if e["ph"] == "i"]
+        assert inst[0]["name"] == "chaos.drop_heartbeats"
+
+    def test_straggler_flagging(self, tmp_path):
+        from tony_tpu.obs.trace_tool import stragglers
+
+        ev_dir = tmp_path / "events"
+        ev_dir.mkdir()
+        events = []
+        for step in (10, 20, 30):
+            events.append({"type": "METRICS", "ts": 100.0 + step,
+                           "task": "worker:0", "samples": {"step": step}})
+            events.append({"type": "METRICS", "ts": 100.0 + step,
+                           "task": "worker:1", "samples": {"step": step // 3}})
+        with open(ev_dir / "app.jhist.jsonl", "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        flags = stragglers(str(tmp_path))
+        assert [f["task"] for f in flags] == ["worker:1"]
+        assert flags[0]["behind_steps"] == 20
+        assert flags[0]["steps_per_s"] > 0
+
+    def test_goodput_prices_sigkilled_restart(self, tmp_path):
+        """A kill_container'd attempt leaves only a begin-only user_process
+        record (emergency-flushed pre-SIGKILL): its ``fts`` kill-time proxy
+        must price the relaunch hole, or restart_s reports 0 for exactly
+        the restart type the flight recorder exists to measure."""
+        from tony_tpu.obs.trace_tool import goodput
+
+        tdir = tmp_path / "trace"
+        # attempt 0: killed at t=3s, 2s into its user process (B + fts)
+        self._write_journal(tdir, "worker_0_exec_a0", 200, [
+            {"ph": "B", "name": "executor.user_process", "ts": 1_000_000,
+             "fts": 3_000_000, "sid": "u0", "psid": "",
+             "args": {"task": "worker:0", "attempt": 0}},
+        ])
+        # attempt 1: relaunched at t=5s, runs 4s to completion
+        self._write_journal(tdir, "worker_0_exec_a1", 201, [
+            {"ph": "X", "name": "executor.user_process", "ts": 5_000_000,
+             "dur": 4_000_000, "tid": 1, "sid": "u1", "psid": "",
+             "args": {"task": "worker:0", "attempt": 1}},
+            {"ph": "X", "name": "am.gang_restart", "ts": 3_100_000,
+             "dur": 1_000_000, "tid": 1, "sid": "g", "psid": "", "args": {}},
+        ])
+        g = goodput(str(tmp_path))
+        assert g["restarts"] == 1
+        assert g["restart_s"] == pytest.approx(2.0)  # kill t=3s -> relaunch t=5s
+        # the window opens at the killed attempt's begin-only span (t=1s),
+        # not at the first COMPLETED span (t=3.1s)
+        assert g["window_s"] == pytest.approx(8.0)  # 1s -> 9s
+
+
+def test_trace_chaos_job_end_to_end(tmp_path):
+    """The acceptance scenario: a real client->AM->executor job under a
+    chaos schedule, with the user process joining the trace. The merged
+    Chrome trace must contain spans from THREE processes (AM, executor,
+    user) on one shared timeline, with the injected fault's instant event
+    landing between the heartbeat spans it interrupted."""
+    from tony_tpu.cli.client import TonyClient
+    from tony_tpu.cli.main import main as cli_main
+    from tony_tpu.config.config import TonyConfig
+    from tony_tpu.obs.trace_tool import load_journals, merge_chrome, report
+
+    user = (
+        'python -c "'
+        "import time; from tony_tpu.obs import trace; "
+        "trace.install_from_env(); "
+        "s = trace.span('user.work'); s.__enter__(); time.sleep(1.2); "
+        "s.__exit__(None, None, None); trace.uninstall()\""
+    )
+    cfg = TonyConfig.load(overrides={
+        "application.name": "trace-chaos",
+        "application.framework": "generic",
+        "application.stage_dir": str(tmp_path),
+        "application.timeout_s": 90,
+        "task.heartbeat_interval_ms": 200,
+        "task.max_missed_heartbeats": 25,
+        "chaos.enabled": True,
+        "chaos.faults": json.dumps(
+            [{"type": "drop_heartbeats", "task": "worker:0", "at_count": 2}]
+        ),
+        "job.worker.instances": 1,
+        "job.worker.command": user,
+    })
+    client = TonyClient(cfg)
+    assert client.run(quiet=True) == 0
+    app_dir = client.app_dir
+    procs = load_journals(os.path.join(app_dir, "trace"))
+    by_proc = {p["proc"]: p for p in procs}
+    assert "am_a0" in by_proc
+    assert "worker_0_exec_a0" in by_proc
+    assert "worker_0_user_a0" in by_proc
+    # all three share ONE trace id
+    assert len({p["trace"] for p in procs}) == 1
+    # the user span nests (transitively) under the executor's user_process
+    exec_spans = {s["sid"]: s for s in by_proc["worker_0_exec_a0"]["spans"]}
+    user_work = next(
+        s for s in by_proc["worker_0_user_a0"]["spans"]
+        if s["name"] == "user.work"
+    )
+    parent = exec_spans[user_work["psid"]]
+    assert parent["name"] == "executor.user_process"
+    # the fault fired as an instant event in the executor, BETWEEN the
+    # heartbeat spans it interrupted (beat 2 dropped; beats 1 and 3 real)
+    instants = by_proc["worker_0_exec_a0"]["instants"]
+    fault = next(i for i in instants if i["name"] == "chaos.drop_heartbeats")
+    beats = sorted(
+        (s for s in by_proc["worker_0_exec_a0"]["spans"]
+         if s["name"] == "rpc.client/Heartbeat"),
+        key=lambda s: s["ts"],
+    )
+    assert len(beats) >= 2
+    assert any(b["ts"] + b["dur"] <= fault["ts"] for b in beats)
+    assert any(b["ts"] >= fault["ts"] for b in beats)
+    # AM spans sit on the same wall-anchored timeline
+    am_run = next(s for s in by_proc["am_a0"]["spans"] if s["name"] == "am.run")
+    assert am_run["ts"] <= user_work["ts"]
+    assert am_run["ts"] + am_run["dur"] >= user_work["ts"] + user_work["dur"]
+    # `tony trace` merges it all into one valid Chrome-trace JSON
+    out = os.path.join(str(tmp_path), "merged.json")
+    assert cli_main(["trace", app_dir, "--out", out]) == 0
+    with open(out) as f:
+        merged = json.load(f)
+    span_pids = {e["pid"] for e in merged["traceEvents"] if e["ph"] == "X"}
+    assert len(span_pids) >= 3
+    # merge_chrome/report agree with the CLI output
+    assert merge_chrome(app_dir)["traceEvents"]
+    rep = report(app_dir)
+    assert rep["goodput"]["window_s"] > 0
+    # the AM journaled a registry snapshot (served-RPC counters)
+    am_snap = os.path.join(app_dir, "metrics", "am_a0.json")
+    assert os.path.exists(am_snap)
+    with open(am_snap) as f:
+        snap = json.load(f)
+    assert any(
+        m["name"] == "tony_rpc_requests_total" and m["labels"].get("method") == "Heartbeat"
+        for m in snap["metrics"]
+    )

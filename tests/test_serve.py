@@ -217,3 +217,37 @@ def test_decode_metrics_populated(setup):
     assert m["tokens_per_sec_per_chip"] > 0
     assert m["ttft_avg_s"] > 0
     assert 0 < m["slot_occupancy"] <= 1
+
+
+def test_engine_shutdown_summary(setup, tmp_path, monkeypatch, caplog):
+    """Engine.close() surfaces the final DecodeMetrics summary — including
+    the compile counts, the classic silent serving regression — plus
+    TTFT/TPOT quantiles from the registry histograms, logs it, and
+    snapshots the registry into the job history when running under a
+    tony-tpu job (TONY_APP_DIR)."""
+    import json
+    import logging
+
+    cfg, params = setup
+    monkeypatch.setenv("TONY_APP_DIR", str(tmp_path))
+    monkeypatch.setenv("TONY_JOB_NAME", "serve")
+    monkeypatch.setenv("TONY_TASK_INDEX", "0")
+    eng = Engine(params, cfg, ServeConfig(slots=2, max_len=32, kv_block=8))
+    eng.run([
+        Request(prompt=p, max_new_tokens=4)
+        for p in _prompts(cfg, [3, 5], seed=9)
+    ])
+    with caplog.at_level(logging.INFO, logger="tony_tpu.serve.engine"):
+        s = eng.close()
+    assert s["requests_finished"] == 2
+    assert s["prefill_compiles"] >= 1 and s["decode_compiles"] >= 1
+    assert s["ttft_p99_s"] >= s["ttft_p50_s"] > 0
+    assert any("engine shutdown" in r.message for r in caplog.records)
+    # the registry snapshot landed in the job history for the portal
+    # (suffixed: a fit() snapshot from the same process must coexist)
+    snap_path = tmp_path / "metrics" / "serve_0_user_engine.json"
+    assert snap_path.exists()
+    snap = json.loads(snap_path.read_text())
+    names = {m["name"] for m in snap["metrics"]}
+    assert {"tony_ttft_seconds", "tony_decode_step_seconds",
+            "tony_requests_finished_total"} <= names

@@ -27,6 +27,7 @@ from typing import Any
 
 from tony_tpu.am.events import EventType, EventWriter
 from tony_tpu.chaos import chaos_hook
+from tony_tpu.obs import trace
 from tony_tpu.am.scheduler import SchedulerHooks, TaskScheduler
 from tony_tpu.am.session import JobState, Session, TaskState, TERMINAL
 from tony_tpu.cluster import make_backend
@@ -100,6 +101,10 @@ class ApplicationMaster(ApplicationRpcServicer):
         self._lease_keeper_stop = threading.Event()
         self._lease_ok_t = time.monotonic()
         self._lease_ttl = 0.0
+        # root span for the whole AM attempt (trace spine): opened in run(),
+        # its id rides into every container env so executor/user spans nest
+        # under it on the merged timeline
+        self._run_span = trace.NOOP_SPAN
 
     # --- executor launch ----------------------------------------------------
 
@@ -119,6 +124,17 @@ class ApplicationMaster(ApplicationRpcServicer):
         }
         if self._rendezvous is not None:
             env["TONY_HOROVOD_RENDEZVOUS_PORT"] = str(self._rendezvous.port)
+        tracer = trace.active_tracer()
+        if tracer is not None:
+            # trace context AM -> executor: same trace id, journals in the
+            # shared app-dir trace/, executor roots under the AM run span
+            env[trace.ENV_DIR] = os.path.join(self.app_dir, "trace")
+            env[trace.ENV_TRACE_ID] = tracer.trace_id
+            env[trace.ENV_SAMPLE] = str(tracer.sample_steps)
+            env[trace.ENV_RING] = str(tracer.ring_size)
+            env[trace.ENV_JOURNAL_MB] = str(tracer.max_journal_mb)
+            env[trace.ENV_PROC] = f"{spec.name}_{index}_exec_a{attempt}"
+            env[trace.ENV_PARENT] = self._run_span.sid
         log_path = os.path.join(
             self.app_dir, "logs", f"{spec.name}_{index}_attempt{attempt}.log"
         )
@@ -161,6 +177,10 @@ class ApplicationMaster(ApplicationRpcServicer):
             log.info(
                 "registered %s:%d at %s:%d (attempt %d)",
                 request.job_name, request.index, request.host, request.port, request.attempt,
+            )
+            trace.instant(
+                "am.task_registered",
+                task=f"{request.job_name}:{request.index}", attempt=request.attempt,
             )
         return pb.RegisterWorkerSpecResponse(
             accepted=ok, message="" if ok else "unknown task or stale attempt"
@@ -378,6 +398,7 @@ class ApplicationMaster(ApplicationRpcServicer):
     def run(self) -> int:
         """Run the job to completion; returns the client exit code."""
         os.makedirs(os.path.join(self.app_dir, "logs"), exist_ok=True)
+        self._run_span = trace.span("am.run", attempt=self.am_attempt)
         token = None
         if self.config.get_bool(Keys.APPLICATION_SECURITY_ENABLED, False):
             from tony_tpu.rpc.auth import read_token
@@ -430,7 +451,9 @@ class ApplicationMaster(ApplicationRpcServicer):
         try:
             if self.am_attempt > 0:
                 self._recover_from_previous_attempt()
-            self.scheduler.schedule_all(self.specs)
+            with trace.span("am.schedule", parent=self._run_span.sid or None,
+                            generation=self.session.generation):
+                self.scheduler.schedule_all(self.specs)
             self._supervise(deadline)
         except Exception as e:
             log.exception("AM failed")
@@ -440,6 +463,8 @@ class ApplicationMaster(ApplicationRpcServicer):
             self._teardown()
         code = self._client_exit_code()
         self._write_status(code)
+        self._run_span.end(state=self.session.state.value, exit_code=code)
+        trace.flush()
         return code
 
     def _client_exit_code(self) -> int:
@@ -588,6 +613,9 @@ class ApplicationMaster(ApplicationRpcServicer):
             state=t.state.value if t else "",
         )
         self._write_am_state()
+        trace.instant(
+            "am.task_finished", task=f"{job_name}:{index}", exit_code=exit_code,
+        )
         log.info("task %s:%d finished code=%d", job_name, index, exit_code)
 
     def _check_heartbeats(self) -> None:
@@ -604,6 +632,7 @@ class ApplicationMaster(ApplicationRpcServicer):
             ]
         for t in stale:
             log.warning("task %s lost (missed heartbeats)", t.task_id)
+            trace.instant("am.task_lost", task=t.task_id)
             self.session.on_task_lost(t.job_name, t.index)
             self.events.emit(EventType.TASK_FINISHED, task=t.task_id, state="LOST")
             if t.container_id:
@@ -663,16 +692,18 @@ class ApplicationMaster(ApplicationRpcServicer):
         """
         log.warning("gang restart (generation %d)", self.session.generation + 1)
         self.events.emit(EventType.GANG_RESTART, generation=self.session.generation + 1)
-        with self.session.lock:
-            cids = [t.container_id for t in self.session.tasks.values() if t.container_id]
-        for cid in cids:
-            self.backend.release(cid)
-        self.session.reset_for_restart(None)
-        if self._rendezvous is not None:
-            self._rendezvous.clear()  # stale peer info must 404 after restart
-        self._write_am_state()
-        self._drain_notifications()
-        self.scheduler.schedule_all(self.specs)
+        with trace.span("am.gang_restart", parent=self._run_span.sid or None,
+                        generation=self.session.generation + 1):
+            with self.session.lock:
+                cids = [t.container_id for t in self.session.tasks.values() if t.container_id]
+            for cid in cids:
+                self.backend.release(cid)
+            self.session.reset_for_restart(None)
+            if self._rendezvous is not None:
+                self._rendezvous.clear()  # stale peer info must 404 after restart
+            self._write_am_state()
+            self._drain_notifications()
+            self.scheduler.schedule_all(self.specs)
 
     def _restart_tasks(self, job_names: set[str], only_failed: bool) -> None:
         with self.session.lock:
@@ -716,6 +747,17 @@ class ApplicationMaster(ApplicationRpcServicer):
             state=self.session.state.value,
             diagnostics=self.session.diagnostics,
         )
+        # registry snapshot into the job history (the AM's own counters —
+        # served RPCs per method; portal /metrics re-renders it)
+        try:
+            from tony_tpu.obs.registry import write_snapshot
+
+            proc = f"am_a{self.am_attempt}"
+            write_snapshot(
+                os.path.join(self.app_dir, "metrics", f"{proc}.json"), proc=proc
+            )
+        except Exception:
+            log.debug("registry snapshot failed", exc_info=True)
         self.events.close()
         # Leave the RPC server up briefly so the client's final status poll
         # lands; the process exits right after run() returns anyway.
@@ -762,11 +804,13 @@ def main() -> None:
     from tony_tpu.chaos import install_from_config
 
     install_from_config(config, role="am")
-    am = ApplicationMaster(
-        config, app_id, app_dir,
-        am_attempt=int(os.environ.get("TONY_AM_ATTEMPT", "0")),
-    )
+    am_attempt = int(os.environ.get("TONY_AM_ATTEMPT", "0"))
+    # arm the trace spine for THIS process (on by default; trace.enabled
+    # false disarms the whole job — container env is derived from this)
+    trace.install_from_config(config, app_dir, app_id, proc=f"am_a{am_attempt}")
+    am = ApplicationMaster(config, app_id, app_dir, am_attempt=am_attempt)
     code = am.run()
+    trace.uninstall()  # flush + close the journal before exit
     # Give the client one status-poll interval to observe the final state.
     time.sleep(1.0)
     if am._server is not None:

@@ -242,9 +242,20 @@ class ChaosInjector:
     def _act(self, f: FaultSpec, count: int, now: float) -> None:
         with self._lock:
             self.fired.append(f.describe())
+        # the injected fault lands on the shared trace timeline as an
+        # instant event, so a post-mortem sees it BETWEEN the spans it
+        # interrupted (no-op when this process is untraced)
+        from tony_tpu.obs import trace
+
+        trace.instant(
+            f"chaos.{f.type}", point=f.point, count=count, fault=f.describe()
+        )
         if f.type in ("kill_container", "kill_am"):
-            # log + flush first: the kill is immediate and unhandled
+            # log + flush first: the kill is immediate and unhandled — the
+            # trace journal must land NOW, including the spans still OPEN
+            # (they are what the fault interrupts; they die with the process)
             log.warning("chaos: firing %s (count=%d t=%.2fs) — SIGKILL", f.describe(), count, now)
+            trace.emergency_flush()
             for h in logging.getLogger().handlers:
                 try:
                     h.flush()

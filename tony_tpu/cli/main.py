@@ -201,6 +201,40 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     return 0 if ok else 1
 
 
+def cmd_trace(args: argparse.Namespace) -> int:
+    """Merge an application's per-process trace journals into ONE
+    Chrome-trace-event JSON (open in Perfetto / chrome://tracing), plus a
+    goodput roll-up and cross-host straggler flags (docs/OBS.md)."""
+    from tony_tpu.obs.trace_tool import load_journals, merge_chrome, report
+
+    app_dir = resolve_app_dir(args.app)
+    # journals can be large (rotating windows per process) — parse once,
+    # share across merge and report
+    procs = load_journals(os.path.join(app_dir, "trace"))
+    merged = merge_chrome(app_dir, procs)
+    # count every renderable event (complete X, begin-only B from killed
+    # processes, instants) — a job whose every process died early still
+    # has exactly the flight-recorder data worth merging
+    n_events = sum(
+        1 for e in merged["traceEvents"] if e.get("ph") in ("X", "B", "i")
+    )
+    if n_events == 0:
+        print(
+            f"no trace journals under {os.path.join(app_dir, 'trace')} "
+            "(job predates tracing, or trace.enabled was false)",
+            file=sys.stderr,
+        )
+        return 1
+    out_path = args.out or os.path.join(app_dir, "trace.json")
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    summary = report(app_dir, procs)
+    summary["out"] = out_path
+    summary["events"] = len(merged["traceEvents"])
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_rm_status(args: argparse.Namespace) -> int:
     """Inspect (or clean) the shared ResourceManager lease store — the
     `yarn top` analogue for the cross-job arbitration substrate."""
@@ -301,6 +335,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--quiet", action="store_true")
     s.set_defaults(fn=cmd_chaos)
+
+    s = sub.add_parser(
+        "trace",
+        help="merge an app's trace journals into one Chrome-trace JSON "
+             "(Perfetto-loadable) with a goodput/straggler report",
+    )
+    s.add_argument("app", help="application id or app-dir path")
+    s.add_argument(
+        "--out", default="",
+        help="output path (default <app_dir>/trace.json)",
+    )
+    s.set_defaults(fn=cmd_trace)
 
     s = sub.add_parser(
         "rm-status",

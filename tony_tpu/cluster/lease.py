@@ -49,6 +49,7 @@ from typing import Callable, Iterator, Mapping, Sequence
 
 from tony_tpu.chaos import chaos_hook
 from tony_tpu.cluster.backend import InsufficientResources, Resource
+from tony_tpu.obs import trace
 
 log = logging.getLogger(__name__)
 
@@ -177,7 +178,11 @@ class LeaseStore:
         # survivors — exactly the real failure's shape. No-op unless this
         # process armed an injector.
         chaos_hook("lease.locked", root=self.root)
-        with open(self._lock_path, "a+") as lockf:
+        # trace spine: one span per locked read-modify-write, so store
+        # contention/hangs are visible on the shared timeline (no-op when
+        # this process is untraced)
+        sp = trace.span("lease.locked")
+        with sp, open(self._lock_path, "a+") as lockf:
             fcntl.flock(lockf, fcntl.LOCK_EX)
             try:
                 before = ""

@@ -60,6 +60,18 @@ class Keys:
     TRAIN_JAX_CACHE_DIR = "train.jax_cache_dir"  # default ~/.tony-tpu/jax_cache
     # cloud-tpu-diagnostics periodic stack traces (wedged-job debugging)
     DIAGNOSTICS_ENABLED = "diagnostics.enabled"
+    # distributed trace spine (obs/trace.py; docs/OBS.md): always-on sampled
+    # span recording across AM/executor/user processes, merged by
+    # `tony trace <app_id>` into one Chrome-trace JSON
+    TRACE_ENABLED = "trace.enabled"
+    # record every Nth train/serve step as a span (1 = every step);
+    # control-plane and lifecycle spans are never sampled away
+    TRACE_SAMPLE_STEPS = "trace.sample_steps"
+    # per-process in-memory span ring; overflow drops oldest and counts
+    TRACE_RING_EVENTS = "trace.ring_events"
+    # per-process journal rotation size: at the cap the journal rotates and
+    # the newest window is kept (flight-recorder retention, <= 2x on disk)
+    TRACE_MAX_JOURNAL_MB = "trace.max_journal_mb"
 
     # --- cluster backend ---
     # Deliberate non-goals vs the reference key surface: docker keys (no
@@ -166,6 +178,10 @@ DEFAULTS: dict[str, object] = {
     Keys.TRAIN_JAX_CACHE_DIR: "",
 
     Keys.DIAGNOSTICS_ENABLED: False,
+    Keys.TRACE_ENABLED: True,
+    Keys.TRACE_SAMPLE_STEPS: 16,
+    Keys.TRACE_RING_EVENTS: 4096,
+    Keys.TRACE_MAX_JOURNAL_MB: 64,
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",

@@ -23,6 +23,7 @@ import time
 import grpc
 
 from tony_tpu.chaos import chaos_hook
+from tony_tpu.obs import trace
 from tony_tpu.config.config import TonyConfig
 from tony_tpu.config.keys import Keys
 from tony_tpu.rpc import ApplicationRpcClient, pb
@@ -124,6 +125,9 @@ class TaskExecutor:
                 resp = self.client.heartbeat(self.job_name, self.index, self.attempt)
                 if resp.action == pb.HeartbeatResponse.ABORT:
                     log.warning("AM ordered abort; killing user process")
+                    trace.instant(
+                        "executor.abort", task=f"{self.job_name}:{self.index}"
+                    )
                     self._abort.set()
                     break
             except grpc.RpcError:
@@ -154,7 +158,9 @@ class TaskExecutor:
     # --- main ----------------------------------------------------------------
 
     def run(self) -> int:
-        self.register()
+        with trace.span("executor.register",
+                        task=f"{self.job_name}:{self.index}"):
+            self.register()
         log.info(
             "%s:%d registered at %s:%d (attempt %d); awaiting cluster spec",
             self.job_name, self.index, self.host, self.port, self.attempt,
@@ -164,7 +170,9 @@ class TaskExecutor:
         # while to assemble must not look heartbeat-dead to the AM.
         hb = threading.Thread(target=self._heartbeat_loop, daemon=True, name="heartbeat")
         hb.start()
-        identity = self.await_cluster_spec()
+        with trace.span("executor.await_cluster_spec",
+                        task=f"{self.job_name}:{self.index}"):
+            identity = self.await_cluster_spec()
         env = self.runtime.build_env(identity, self.config)
         env["TONY_APP_ID"] = os.environ.get("TONY_APP_ID", "")
         env["TONY_APP_DIR"] = os.environ.get("TONY_APP_DIR", "")
@@ -187,6 +195,17 @@ class TaskExecutor:
         src_dir = os.path.join(os.environ.get("TONY_APP_DIR", ""), "src")
         cwd = src_dir if os.path.isdir(src_dir) else None
         log.info("starting user process: %s (cwd=%s)", command, cwd or ".")
+        # the user process joins the trace under its own journal name,
+        # rooted on this span (fit()/the engine call trace.install_from_env)
+        user_span = trace.span(
+            "executor.user_process",
+            task=f"{self.job_name}:{self.index}", attempt=self.attempt,
+        )
+        if trace.active_tracer() is not None:
+            env[trace.ENV_PROC] = (
+                f"{self.job_name}_{self.index}_user_a{self.attempt}"
+            )
+            env[trace.ENV_PARENT] = user_span.sid
         self._child = run_logged(command, env=env, cwd=cwd)
 
         mt = threading.Thread(target=self._metrics_loop, daemon=True, name="metrics")
@@ -212,6 +231,7 @@ class TaskExecutor:
             time.sleep(0.2)
 
         log.info("user process exited with code %d", code)
+        user_span.end(exit_code=code)
         self._abort.set()
         try:
             self.client.register_execution_result(
@@ -235,7 +255,11 @@ def main() -> None:
     from tony_tpu.chaos import install_from_config
 
     install_from_config(executor.config, role="executor")
-    sys.exit(executor.run())
+    # join the trace spine from the AM-exported env (no-op when untraced)
+    trace.install_from_env()
+    code = executor.run()
+    trace.uninstall()  # flush + close the journal before exit
+    sys.exit(code)
 
 
 if __name__ == "__main__":

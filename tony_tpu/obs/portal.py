@@ -13,6 +13,9 @@ Endpoints:
     /job/<app_id>/log/<task>   task log (text)
     /api/jobs            jobs list (JSON)
     /api/job/<app_id>    full detail (JSON)
+    /metrics             Prometheus text exposition over every app's
+                         registry snapshots (step time / TTFT / TPOT
+                         histograms etc., labelled app= and proc=)
 
 Run:  python -m tony_tpu.obs.portal --port 8080 [--apps-root DIR]
 """
@@ -103,6 +106,36 @@ class PortalData:
                 return f.read()
         except OSError:
             return None
+
+    def metric_snapshots(self) -> list[tuple[dict, list[dict]]]:
+        """Every registry snapshot under every app's ``metrics/`` dir, as
+        (extra-labels, entries) pairs for registry.render_snapshots — the
+        fit()/engine/AM shutdown snapshots become one fleet-wide scrape."""
+        out: list[tuple[dict, list[dict]]] = []
+        if not os.path.isdir(self.apps_root):
+            return out
+        for app_id in sorted(os.listdir(self.apps_root)):
+            mdir = os.path.join(self.apps_root, app_id, "metrics")
+            if not os.path.isdir(mdir):
+                continue
+            for name in sorted(os.listdir(mdir)):
+                if not name.endswith(".json"):
+                    continue
+                snap = _read_json(os.path.join(mdir, name))
+                if not isinstance(snap, dict):
+                    continue
+                entries = snap.get("metrics")
+                if isinstance(entries, list):
+                    out.append((
+                        {"app": app_id, "proc": snap.get("proc", name[:-5])},
+                        entries,
+                    ))
+        return out
+
+    def prometheus(self) -> str:
+        from tony_tpu.obs.registry import render_snapshots
+
+        return render_snapshots(self.metric_snapshots())
 
 
 _PAGE = """<!doctype html><html><head><title>tony-tpu portal</title><style>
@@ -275,6 +308,10 @@ def make_handler(data: PortalData):
             parts = [p for p in self.path.split("/") if p]
             if not parts:
                 return self._send(200, _jobs_html(data.jobs()))
+            if parts[0] == "metrics" and len(parts) == 1:
+                return self._send(
+                    200, data.prometheus(), "text/plain; version=0.0.4"
+                )
             if parts[0] == "api":
                 if len(parts) == 2 and parts[1] == "jobs":
                     return self._send(200, json.dumps(data.jobs()), "application/json")

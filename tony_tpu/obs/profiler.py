@@ -38,13 +38,30 @@ def trace_window(log_dir: str, enabled: bool = True):
     """Trace everything inside the block into ``log_dir`` (perfetto/XPlane).
 
     The caller must block_until_ready inside the window for device activity
-    to be attributed (dispatch is async)."""
+    to be attributed (dispatch is async). Finalisation is try/finally: an
+    exception inside the traced block still stops the trace and logs where
+    it landed — the partial trace of a crashing step is exactly the one
+    worth keeping, and an unfinalised profiler session would poison the
+    next trace_window with a "already tracing" error."""
     if not enabled:
         yield
         return
-    with jax.profiler.trace(log_dir):
+    jax.profiler.start_trace(log_dir)
+    try:
         yield
-    log.info("profiler trace written to %s", log_dir)
+    finally:
+        # swallow a stop_trace failure (logging it): raising here would
+        # mask an in-flight exception from the traced block, and the
+        # success line must not lie about a trace that never landed
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            log.warning(
+                "profiler trace finalisation failed for %s", log_dir,
+                exc_info=True,
+            )
+        else:
+            log.info("profiler trace written to %s", log_dir)
 
 
 def annotate(name: str):

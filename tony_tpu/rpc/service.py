@@ -17,6 +17,8 @@ from typing import Any, Callable
 import grpc
 
 from tony_tpu.chaos import chaos_hook
+from tony_tpu.obs import trace
+from tony_tpu.obs.registry import get_registry
 from tony_tpu.rpc import tony_pb2 as pb
 
 log = logging.getLogger(__name__)
@@ -75,18 +77,46 @@ class ApplicationRpcServicer:
         raise NotImplementedError
 
 
+def _remote_parent(context) -> str:
+    """Span id the caller attached in metadata ('' for untraced callers)."""
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == trace.RPC_METADATA_KEY:
+                return v.rsplit("/", 1)[-1]
+    except Exception:
+        pass
+    return ""
+
+
 def _wrap(method: Callable[[Any, Any], Any]) -> Callable[[Any, Any], Any]:
+    requests = get_registry().counter(
+        "tony_rpc_requests_total", "served control-plane RPCs",
+        method=method.__name__,
+    )
+
     def handler(request, context):
         # chaos seam: delay_rpc injects latency into served control-plane
         # calls (per-method filterable); no-op unless this process armed
         chaos_hook("rpc.server", method=method.__name__)
-        try:
-            return method(request, context)
-        except NotImplementedError:
-            context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
-        except Exception as e:  # surface servicer bugs to the caller
-            log.exception("rpc %s failed", method.__name__)
-            context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+        requests.inc()
+        tracer = trace.active_tracer()
+        sp = trace.NOOP_SPAN
+        if tracer is not None:
+            # server dispatch span, parented on the CALLER's client span
+            # via metadata — the cross-process edge of the trace tree
+            sp = tracer.span(
+                f"rpc.server/{method.__name__}",
+                parent=_remote_parent(context) or None,
+                method=method.__name__,
+            )
+        with sp:
+            try:
+                return method(request, context)
+            except NotImplementedError:
+                context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+            except Exception as e:  # surface servicer bugs to the caller
+                log.exception("rpc %s failed", method.__name__)
+                context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
 
     return handler
 
@@ -162,9 +192,19 @@ class ApplicationRpcClient:
 
     def _call(self, name: str, request, timeout_s: float | None = None):
         stub = getattr(self, f"_stub_{name}")
-        return stub(
-            request, timeout=timeout_s or self.timeout_s, metadata=self._metadata
-        )
+        tracer = trace.active_tracer()
+        if tracer is None:
+            return stub(
+                request, timeout=timeout_s or self.timeout_s, metadata=self._metadata
+            )
+        # client dispatch span; its id rides the call metadata so the
+        # server's span parents on it across the process boundary —
+        # tracer.ctx() owns the "<trace_id>/<span_id>" wire format
+        with tracer.span(f"rpc.client/{name}", method=name):
+            md = tuple(self._metadata or ()) + (
+                (trace.RPC_METADATA_KEY, tracer.ctx()),
+            )
+            return stub(request, timeout=timeout_s or self.timeout_s, metadata=md)
 
     # --- executor-side ---
     def register_worker_spec(

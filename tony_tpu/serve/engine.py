@@ -35,6 +35,7 @@ notes and knob guide.
 from __future__ import annotations
 
 import functools
+import logging
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -47,11 +48,15 @@ import numpy as np
 from jax import lax
 
 from tony_tpu.models.llama import LlamaConfig, Params, rms_norm, rope_freqs
+from tony_tpu.obs import trace
 from tony_tpu.obs.metrics import DecodeMetrics
+from tony_tpu.obs.registry import Registry, snapshot_to_app_dir
 from tony_tpu.ops.decode_attention import decode_attention
 from tony_tpu.serve.cache import (
     BlockKVCache, blocks_for, create_cache, grow_cache, shrink_cache,
 )
+
+log = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -208,6 +213,19 @@ class Engine:
         self._next_rid = 0
         self._prefill_fns: dict[int, Any] = {}
         self._decode_fns: dict[int, Any] = {}
+        # trace/metrics spine: join the job's trace from the AM-exported
+        # env (no-op outside a traced tony-tpu job, idempotent when the
+        # user script armed it already), then per-request span handles
+        # (queued -> prefill -> decode -> finish) and the TTFT/TPOT/
+        # step-time distributions the portal /metrics endpoint serves
+        # (docs/OBS.md catalogue). Per-engine registry: a recreated engine
+        # (restart, bench sweep) reports its own distributions, not a
+        # blend with its predecessor's
+        trace.install_from_env()
+        self._init_registry()
+        self._queued_spans: dict[int, Any] = {}
+        self._decode_spans: dict[int, Any] = {}
+        self._first_tok_t: dict[int, float] = {}
 
     # --- public API -----------------------------------------------------------
 
@@ -235,21 +253,74 @@ class Engine:
         self._next_rid += 1
         self._queue.append((rid, req))
         self._submit_t[rid] = time.perf_counter()
+        self._g_queue.set(len(self._queue))
+        tracer = trace.active_tracer()
+        if tracer is not None:
+            # queue-wait span: starts now, ends when the request is slotted
+            self._queued_spans[rid] = tracer.span(
+                "serve.queued", rid=rid, prompt_len=plen
+            )
         return rid
 
     @property
     def n_live(self) -> int:
         return sum(1 for r in self._slot_rid if r is not None)
 
+    def _init_registry(self) -> None:
+        reg = self.registry = Registry()
+        self._h_ttft = reg.histogram("tony_ttft_seconds",
+                                     "request submit -> first sampled token")
+        self._h_tpot = reg.histogram("tony_tpot_seconds",
+                                     "mean per-token latency after the first")
+        self._h_step = reg.histogram("tony_decode_step_seconds",
+                                     "one engine decode step (all live slots)")
+        self._g_queue = reg.gauge("tony_queue_depth",
+                                  "requests admitted but not yet slotted")
+        self._c_tokens = reg.counter("tony_generated_tokens_total",
+                                     "tokens sampled (prefill + decode)")
+        self._c_finished = reg.counter("tony_requests_finished_total",
+                                       "requests completed (eos or budget)")
+
     def reset_metrics(self) -> None:
         """Fresh throughput/latency counters (e.g. after a warmup trace
         that paid the compiles); compile counts persist — they describe
-        the engine, not the trace."""
+        the engine, not the trace. The registry histograms reset too, or
+        close()'s TTFT/TPOT quantiles and the job-history snapshot would
+        blend warmup compile time into the measured trace."""
         self.metrics = DecodeMetrics(
             n_chips=self.metrics.n_chips,
             prefill_compiles=len(self._prefill_fns),
             decode_compiles=len(self._decode_fns),
         )
+        self._init_registry()
+        self._g_queue.set(len(self._queue))
+
+    def close(self) -> dict:
+        """Shutdown summary: log + return the final DecodeMetrics summary
+        (TTFT, tokens/s/chip, and — the silent regression — the compile
+        counts) so it is visible without reading the portal, and snapshot
+        the metrics registry into the job history when running under a
+        tony-tpu job. Quantiles come from the registry histograms.
+        Requests still queued or mid-decode get their spans ended with
+        reason=shutdown — a hung request must be visible in the trace."""
+        for spans in (self._queued_spans, self._decode_spans):
+            for sp in spans.values():
+                sp.end(reason="shutdown")
+            spans.clear()
+        self._first_tok_t.clear()
+        s = self.metrics.summary()
+        if self._h_ttft.count:
+            s["ttft_p50_s"] = round(self._h_ttft.quantile(0.5), 4)
+            s["ttft_p99_s"] = round(self._h_ttft.quantile(0.99), 4)
+        if self._h_tpot.count:
+            s["tpot_p50_s"] = round(self._h_tpot.quantile(0.5), 4)
+        log.info("engine shutdown: %s", s)
+        # suffixed so a train-then-serve user process cannot overwrite one
+        # component's snapshot with the other's
+        snapshot_to_app_dir(
+            trace.default_proc_name("serve") + "_engine", self.registry
+        )
+        return s
 
     def step(self) -> int:
         """Admit what fits, run one decode step; returns live-slot count."""
@@ -286,21 +357,33 @@ class Engine:
 
     def _admit_one(self, slot: int, rid: int, req: Request) -> None:
         t0 = time.perf_counter()
+        qspan = self._queued_spans.pop(rid, None)
+        if qspan is not None:
+            qspan.end(slot=slot)
+        self._g_queue.set(len(self._queue))
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         plen = len(prompt)
         bucket = self._bucket_for(plen)
-        self._ensure_capacity(max(bucket, plen + 1))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = prompt
-        key = _as_raw_key(req.rng, rid)
-        tok, carry, pk, pv = self._get_prefill(bucket)(
-            self.params, jnp.asarray(padded), jnp.int32(plen - 1),
-            jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jnp.float32(req.top_p), key,
-        )
-        tok = int(np.asarray(tok))
+        with trace.span("serve.prefill", rid=rid, bucket=bucket, slot=slot):
+            self._ensure_capacity(max(bucket, plen + 1))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = prompt
+            key = _as_raw_key(req.rng, rid)
+            tok, carry, pk, pv = self._get_prefill(bucket)(
+                self.params, jnp.asarray(padded), jnp.int32(plen - 1),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p), key,
+            )
+            tok = int(np.asarray(tok))
         now = time.perf_counter()
         self.metrics.record_prefill(now - t0, now - self._submit_t[rid])  # popped below
+        self._h_ttft.observe(now - self._submit_t[rid])
+        self._c_tokens.inc()
+        self._first_tok_t[rid] = now
+        tracer = trace.active_tracer()
+        if tracer is not None:
+            # decode-lifetime span: first token -> finish
+            self._decode_spans[rid] = tracer.span("serve.decode", rid=rid, slot=slot)
 
         self.cache = _insert_fn()(
             self.cache, pk, pv, jnp.int32(slot), jnp.int32(plen)
@@ -333,8 +416,19 @@ class Engine:
 
     def _finish(self, slot: int, reason: str) -> None:
         rid = self._slot_rid[slot]
-        self._completions[rid].finish_reason = reason
+        comp = self._completions[rid]
+        comp.finish_reason = reason
         self.metrics.requests_finished += 1
+        self._c_finished.inc()
+        t_first = self._first_tok_t.pop(rid, None)
+        if t_first is not None and len(comp.tokens) > 1:
+            # TPOT: decode-token cadence after the prefill-sampled first
+            self._h_tpot.observe(
+                (time.perf_counter() - t_first) / (len(comp.tokens) - 1)
+            )
+        dspan = self._decode_spans.pop(rid, None)
+        if dspan is not None:
+            dspan.end(tokens=len(comp.tokens), reason=reason)
         self._slot_rid[slot] = None
         self._slot_remaining[slot] = 0
         self._slot_len[slot] = 0
@@ -395,16 +489,23 @@ class Engine:
     def _decode_once(self) -> None:
         self._ensure_capacity(1)
         live_before = [s for s, r in enumerate(self._slot_rid) if r is not None]
-        t0 = time.perf_counter()
-        self.cache, self.state, toks = self._get_decode(self.cache.capacity)(
-            self.params, self.cache, self.state
-        )
-        toks_np = np.asarray(toks)
-        done_np = np.asarray(self.state.done)
-        dt = time.perf_counter() - t0
+        tracer = trace.active_tracer()
+        sp = trace.NOOP_SPAN
+        if tracer is not None:
+            sp = tracer.sampled_span("serve.step", live=len(live_before))
+        with sp:
+            t0 = time.perf_counter()
+            self.cache, self.state, toks = self._get_decode(self.cache.capacity)(
+                self.params, self.cache, self.state
+            )
+            toks_np = np.asarray(toks)
+            done_np = np.asarray(self.state.done)
+            dt = time.perf_counter() - t0
         self.metrics.record_decode(
             dt, len(live_before), len(live_before), self.serve.slots
         )
+        self._h_step.observe(dt)
+        self._c_tokens.inc(len(live_before))
         for s in live_before:
             self._slot_len[s] += 1
             self._completions[self._slot_rid[s]].tokens.append(int(toks_np[s]))

@@ -40,7 +40,9 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from tony_tpu.models.llama import LlamaConfig, train_flops_per_token
+from tony_tpu.obs import trace
 from tony_tpu.obs.metrics import StepTimer, chip_peak_flops
+from tony_tpu.obs.registry import Registry, snapshot_to_app_dir
 from tony_tpu.parallel.mesh import MeshShape, build_mesh
 from tony_tpu.parallel.sharding import DEFAULT_RULES, Rules, spec_for
 from tony_tpu.runtime import jax_tpu
@@ -114,8 +116,13 @@ def fit(cfg: FitConfig) -> dict:
     """Run the training loop to cfg.steps; returns final metrics."""
     from tony_tpu.obs.diagnostics import diagnostics_context
 
-    with diagnostics_context():
-        return _fit(cfg)
+    # join the job's trace spine (no-op outside a traced tony-tpu job);
+    # every span below nests under train.fit on the merged timeline — the
+    # root handle rides into _fit because the compile-ahead worker thread
+    # has an empty span stack and must parent on it explicitly
+    trace.install_from_env()
+    with diagnostics_context(), trace.span("train.fit", steps=cfg.steps) as root:
+        return _fit(cfg, root)
 
 
 def _start_async_host_copy(metrics: dict) -> None:
@@ -130,7 +137,7 @@ def _start_async_host_copy(metrics: dict) -> None:
                 pass
 
 
-def _fit(cfg: FitConfig) -> dict:
+def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     jax_tpu.initialize()  # no-op outside a tony-tpu job
     cfg.apply_job_env()
     if cfg.ce_impl or cfg.moe_dispatch or cfg.moe_group_block:
@@ -208,15 +215,18 @@ def _fit(cfg: FitConfig) -> dict:
 
         def _compile_ahead() -> None:
             t0 = time.perf_counter()
-            try:
-                aot["step"] = step_fn.lower(
-                    state_avals, batch_aval, batch_aval
-                ).compile()
-            except Exception:
-                log.debug(
-                    "compile-ahead failed; jit dispatch compiles lazily",
-                    exc_info=True,
-                )
+            # runs on the compile-ahead thread (empty span stack): parent
+            # on train.fit explicitly or this lands beside it, not inside
+            with trace.span("fit.startup.compile", parent=fit_span.sid or None):
+                try:
+                    aot["step"] = step_fn.lower(
+                        state_avals, batch_aval, batch_aval
+                    ).compile()
+                except Exception:
+                    log.debug(
+                        "compile-ahead failed; jit dispatch compiles lazily",
+                        exc_info=True,
+                    )
             startup["compile_s"] = round(time.perf_counter() - t0, 3)
 
         compile_thread = threading.Thread(
@@ -238,7 +248,8 @@ def _fit(cfg: FitConfig) -> dict:
         )
         if cfg.resume:
             t0 = time.perf_counter()
-            state, restored = manager.restore(state)
+            with trace.span("fit.startup.restore"):
+                state, restored = manager.restore(state)
             startup["restore_s"] = round(time.perf_counter() - t0, 3)
             if restored >= 0:
                 start_step = restored
@@ -301,32 +312,87 @@ def _fit(cfg: FitConfig) -> dict:
     steady_t0 = None        # wall clock after the first step fully resolved
     t_window = time.perf_counter()
     window = 0
+
+    def _dispatch(state, inputs, targets):
+        nonlocal compiled_step
+        if compiled_step is not None:
+            try:
+                return compiled_step(state, inputs, targets)
+            except (TypeError, ValueError):
+                # aval/sharding mismatch between the AOT signature and
+                # the live arrays (raised before execution, so nothing
+                # was donated) — fall back to jit dispatch permanently;
+                # real runtime faults (OOM etc.) propagate as usual
+                log.warning(
+                    "compile-ahead executable rejected live args; "
+                    "falling back to jit dispatch", exc_info=True,
+                )
+                compiled_step = None
+        return step_fn(state, inputs, targets)
+
+    # trace spine: every trace.sample_steps-th step is a span, mirrored
+    # onto the device timeline via jax.profiler.TraceAnnotation with the
+    # SAME name so a Perfetto/XPlane capture lines up with tony trace
+    tracer = trace.active_tracer()
+    # steady-state step-time distribution (p50/p95/p99 in the final report
+    # and on the portal /metrics endpoint); host-side loop cadence —
+    # individual iterations are noisy under async dispatch, the
+    # distribution over a run is the signal
+    # per-run registry: a second fit() in the same process (bench sweeps)
+    # must report THIS run's distribution, not a blend with the last one
+    registry = Registry()
+    h_step = registry.histogram(
+        "tony_step_time_seconds",
+        "train step wall time (synced sampled steps; log-window means when untraced)",
+    )
+    from tony_tpu.obs.profiler import annotate
+
     try:
         for step in range(start_step, cfg.steps):
             t_fetch = time.perf_counter()
-            inputs, targets = next(batches)
-            fetch_s = time.perf_counter() - t_fetch
             if step == start_step:
+                with trace.span("fit.startup.first_batch"):
+                    inputs, targets = next(batches)
+                fetch_s = time.perf_counter() - t_fetch
                 startup["first_batch_s"] = round(fetch_s, 3)
             else:
+                inputs, targets = next(batches)
+                fetch_s = time.perf_counter() - t_fetch
                 host_window_s += fetch_s
                 host_steady_s += fetch_s
-            if compiled_step is not None:
-                try:
-                    state, metrics = compiled_step(state, inputs, targets)
-                except (TypeError, ValueError):
-                    # aval/sharding mismatch between the AOT signature and
-                    # the live arrays (raised before execution, so nothing
-                    # was donated) — fall back to jit dispatch permanently;
-                    # real runtime faults (OOM etc.) propagate as usual
-                    log.warning(
-                        "compile-ahead executable rejected live args; "
-                        "falling back to jit dispatch", exc_info=True,
-                    )
-                    compiled_step = None
-                    state, metrics = step_fn(state, inputs, targets)
+            # first step excluded from sampling (like h_step below): its
+            # compile/warmup-inflated duration would be stride-scaled by
+            # the goodput roll-up, and its fetch is already attributed to
+            # the fit.startup.first_batch span
+            sp = trace.NOOP_SPAN
+            if tracer is not None and step != start_step:
+                sp = tracer.sampled_span(
+                    "train.step", step=step + 1,
+                    fetch_ms=round(fetch_s * 1e3, 3),
+                )
+            if sp is not trace.NOOP_SPAN:
+                # dispatch is async: an unsynced span times the enqueue
+                # (microseconds) and the goodput roll-up would misattribute
+                # the whole window. Drain the dispatch backlog BEFORE the
+                # span, sync on the result inside it, so the span covers
+                # exactly this step's device time; the cost is one pipeline
+                # sync per sample_steps — same class as the deferred
+                # log-boundary sync.
+                jax.block_until_ready(state)
+                t_sync = time.perf_counter()
+                with sp, annotate("train.step"):
+                    state, metrics = _dispatch(state, inputs, targets)
+                    jax.block_until_ready(metrics)
+                # the synced iteration observes the span-internal time (true
+                # device step, backlog excluded). Unsampled iterations never
+                # observe: under async dispatch they time only the enqueue,
+                # and mixing the two classes makes the quantiles bimodal
+                # nonsense. Disarmed runs fall back to log-window means at
+                # the boundary below — every observation in one histogram is
+                # measured the same way.
+                h_step.observe(time.perf_counter() - t_sync)
             else:
-                state, metrics = step_fn(state, inputs, targets)
+                state, metrics = _dispatch(state, inputs, targets)
             window += 1
             if pending is not None:
                 _emit(pending)  # previous boundary, now that N+1 is in flight
@@ -346,6 +412,12 @@ def _fit(cfg: FitConfig) -> dict:
                     "startup": dict(startup) if step == start_step else None,
                 }
                 _start_async_host_copy(metrics)
+                if tracer is None and step != start_step:
+                    # disarmed step-time source: the window mean (wall time
+                    # over completed steps — accurate without a per-step
+                    # sync). The first window is excluded like everywhere
+                    # else: it absorbs compile/warmup.
+                    h_step.observe(snap["dt"] / max(snap["window"], 1))
                 if step == start_step or step + 1 == cfg.steps:
                     # first step: latency metric, sync now; last step: the
                     # loop ends here, nothing left to overlap with
@@ -371,10 +443,20 @@ def _fit(cfg: FitConfig) -> dict:
             manager.save(cfg.steps, state, force=True)
         manager.close()
     final = {"final_loss": float(metrics.get("loss", float("nan"))), "steps": cfg.steps}
+    if h_step.count:
+        # step-time distribution (bucketed quantiles): the portal /metrics
+        # endpoint re-renders the full histogram from the snapshot below
+        final["step_time_p50_s"] = round(h_step.quantile(0.5), 4)
+        final["step_time_p95_s"] = round(h_step.quantile(0.95), 4)
+        final["step_time_p99_s"] = round(h_step.quantile(0.99), 4)
     if reporter is not None:
         reporter.close()
         if reporter.dropped:
             final["metrics_dropped"] = reporter.dropped
+    # registry snapshot into the job history (no-op outside a tony job);
+    # suffixed so a train-then-serve user process cannot overwrite one
+    # component's snapshot with the other's
+    snapshot_to_app_dir(trace.default_proc_name("train") + "_fit", registry)
     # steady-state input-stall + throughput accounting (first step excluded:
     # it absorbs warmup). The last boundary _emit synced the final step, so
     # the wall-clock window below covers completed work only.
@@ -390,6 +472,16 @@ def _fit(cfg: FitConfig) -> dict:
         final["host_blocked_frac"] = round(host_steady_s / steady_elapsed, 4)
     if startup:
         final["startup"] = dict(startup)
+    if jax.process_index() == 0:
+        # shutdown summary: silent metric loss must be visible in the
+        # worker log, not only behind the portal
+        log.info(
+            "fit summary: steps=%d loss=%.4f step_p50=%.3fs step_p99=%.3fs "
+            "host_blocked=%s metrics_dropped=%d",
+            cfg.steps, final["final_loss"],
+            final.get("step_time_p50_s", 0.0), final.get("step_time_p99_s", 0.0),
+            final.get("host_blocked_frac", 0.0), final.get("metrics_dropped", 0),
+        )
     return final
 
 
