@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""CI entry point for graft-lint: exit nonzero on NEW findings.
+
+Sits next to ``gen_config_doc.py --check`` in the tier-1 gate family:
+``tests/test_lint.py::test_codebase_is_lint_clean`` runs the same check
+in-process. Usage::
+
+    python scripts/lint.py                 # lint tony_tpu/ vs the baseline
+    python scripts/lint.py --update-baseline   # re-record the baseline
+"""
+
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+from tony_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    os.chdir(REPO)  # paths (and the default baseline) are repo-relative
+    argv = sys.argv[1:]
+    if not any(a for a in argv if not a.startswith("-")):
+        argv = ["tony_tpu"] + argv
+    sys.exit(main(argv))

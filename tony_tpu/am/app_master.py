@@ -321,12 +321,31 @@ class ApplicationMaster(ApplicationRpcServicer):
         """Journal the minimum a successor AM attempt needs: which container
         process groups exist (to reap orphans) and the restart generation
         (so events/metrics stay monotonic across AM attempts)."""
+        # refresh pids that were unknown at allocate time (a remote pid can
+        # arrive after launch) so the journal never undercounts. The backend
+        # query can block (ssh transport on remote backends), so collect the
+        # stale tasks under the lock, query OUTSIDE it, write back under it —
+        # an RPC handler must never wait on a remote host to touch the
+        # session table (GL004 lock-discipline).
         with self.session.lock:
-            # refresh pids that were unknown at allocate time (a remote pid
-            # can arrive after launch) so the journal never undercounts
-            for t in self.session.tasks.values():
-                if t.container_id and not t.container_pid and t.state not in TERMINAL:
-                    t.container_pid = self.backend.container_pid(t.container_id)
+            stale = [
+                (t.task_id, t.container_id)
+                for t in self.session.tasks.values()
+                if t.container_id and not t.container_pid and t.state not in TERMINAL
+            ]
+        pids = {
+            task_id: (cid, self.backend.container_pid(cid))
+            for task_id, cid in stale
+        }
+        with self.session.lock:
+            for task_id, (cid, pid) in pids.items():
+                t = self.session.tasks.get(task_id)
+                # the task may have been restarted (new container) during
+                # the unlocked backend query: only record the pid if it
+                # still belongs to the container it was queried for
+                if (t is not None and not t.container_pid
+                        and t.container_id == cid and t.state not in TERMINAL):
+                    t.container_pid = pid
             snap = {
                 "am_attempt": self.am_attempt,
                 "generation": self.session.generation,
@@ -341,10 +360,13 @@ class ApplicationMaster(ApplicationRpcServicer):
                 },
             }
         path = self._am_state_path()
+        # the write lock EXISTS to serialize this journal write between the
+        # scheduler and supervise threads; holding it across the local file
+        # I/O is its whole job, and no hot path ever waits on it
         with self._am_state_write_lock:
-            with open(path + ".tmp", "w") as f:
-                json.dump(snap, f)
-            os.replace(path + ".tmp", path)
+            with open(path + ".tmp", "w") as f:  # graft-lint: disable=GL004
+                json.dump(snap, f)  # graft-lint: disable=GL004
+            os.replace(path + ".tmp", path)  # graft-lint: disable=GL004
 
     def _recover_from_previous_attempt(self) -> None:
         """Attempt N+1 startup: reap the predecessor's orphaned container
@@ -706,6 +728,10 @@ class ApplicationMaster(ApplicationRpcServicer):
             self.scheduler.schedule_all(self.specs)
 
     def _restart_tasks(self, job_names: set[str], only_failed: bool) -> None:
+        # reset the task table under the lock, release containers OUTSIDE
+        # it (release can block on a remote backend, and RPC handlers need
+        # the session lock to serve heartbeats meanwhile) — same collect-
+        # then-release shape as _gang_restart and _check_heartbeats
         with self.session.lock:
             victims = [
                 t
@@ -713,9 +739,8 @@ class ApplicationMaster(ApplicationRpcServicer):
                 if t.job_name in job_names
                 and (not only_failed or t.state in (TaskState.FAILED, TaskState.LOST))
             ]
+            cids = [t.container_id for t in victims if t.container_id]
             for t in victims:
-                if t.container_id:
-                    self.backend.release(t.container_id)
                 t.state = TaskState.PENDING
                 t.host, t.port = "", 0
                 t.container_id = ""
@@ -724,6 +749,8 @@ class ApplicationMaster(ApplicationRpcServicer):
                 t.attempt += 1
                 t.restarts += 1
                 t.last_heartbeat = 0.0
+        for cid in cids:
+            self.backend.release(cid)
         log.warning("restarting %s", ", ".join(t.task_id for t in victims))
         self._write_am_state()
         self.scheduler.schedule_all(self.specs)

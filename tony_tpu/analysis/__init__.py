@@ -1,0 +1,24 @@
+"""graft-lint: JAX-aware + concurrency-aware static analysis (docs/ANALYSIS.md).
+
+Stdlib-``ast`` only — importable (and runnable) in environments without jax.
+``tony lint [paths]`` and ``scripts/lint.py`` are the entry points; the
+tier-1 gate is ``tests/test_lint.py::test_codebase_is_lint_clean``.
+"""
+
+from tony_tpu.analysis.core import (
+    Baseline,
+    Finding,
+    all_checkers,
+    lint_paths,
+    load_project,
+    run_checkers,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "all_checkers",
+    "lint_paths",
+    "load_project",
+    "run_checkers",
+]

@@ -1,0 +1,787 @@
+"""The graft-lint checkers (docs/ANALYSIS.md has the catalogue with
+bad/good examples per code).
+
+=======  ====================  ==============================================
+code     name                  what it catches
+=======  ====================  ==============================================
+GL001    host-sync-in-jit      ``.item()`` / ``float(tracer)`` / ``np.asarray``
+                               / ``jax.device_get`` / ``print`` in functions
+                               reachable from a jit entry point
+GL002    recompile-hazard      ``jax.jit`` in a loop, jit-of-lambda inside a
+                               function body, Python branch on a traced value,
+                               mutable default behind ``static_argnums``
+GL003    donation-reuse        reading an argument after passing it to a
+                               ``donate_argnums`` jit in the same scope
+GL004    lock-discipline       blocking calls (sleep, unbounded join/wait/
+                               queue-get, file I/O, RPC-ish backend/client
+                               calls) while a lock is held; cross-module
+                               lock-order inversions
+GL005    disarmed-hook-cost    chaos/trace hook call sites whose arguments
+                               allocate or call before the armed check
+=======  ====================  ==============================================
+
+Checkers are tuned to under-approximate (see analysis/callgraph.py): the
+tier-1 zero-findings gate only works if a clean tree needs no blanket
+suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tony_tpu.analysis.callgraph import Project, dotted, unwrap_partial
+from tony_tpu.analysis.core import Finding
+
+# attribute reads that are static under tracing (never a host sync and
+# never tracer-valued themselves)
+_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "sharding", "device", "aval",
+    "itemsize", "nbytes",
+}
+
+# array-producing namespaces: a value returned by these is tracer-typed
+# inside a traced function
+_TRACER_EXCLUDE = {"jax.device_get", "jax.block_until_ready"}
+
+
+def _is_jnpish(resolved: str | None) -> bool:
+    """Does this callee produce traced array values? Restricted to the
+    array namespaces — general ``jax.*`` API calls (mesh/axis-env/sharding
+    introspection) return static metadata and must not taint locals."""
+    if not resolved or resolved in _TRACER_EXCLUDE:
+        return False
+    head = resolved.split(".", 1)[0]
+    return head in ("jnp", "lax") or resolved.startswith(
+        ("jax.numpy.", "jnp.", "lax.", "jax.lax.", "jax.nn.", "jax.random.",
+         "jax.scipy.")
+    )
+
+
+def walk_own(root: ast.AST, *, skip_nested: bool = True) -> Iterator[ast.AST]:
+    """Walk ``root``'s body without descending into nested function/class
+    definitions (they are analyzed as their own symbols)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if skip_nested and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _Emitter:
+    """Finding factory that keeps fingerprints unique when the same
+    (code, path, symbol, detail) occurs more than once."""
+
+    def __init__(self, code: str):
+        self.code = code
+        self._seen: dict[str, int] = {}
+
+    def emit(self, path: str, line: int, symbol: str, message: str,
+             detail: str) -> Finding:
+        base = f"{self.code}|{path}|{symbol}|{detail}"
+        n = self._seen[base] = self._seen.get(base, 0) + 1
+        if n > 1:
+            detail = f"{detail}#{n}"
+        return Finding(self.code, path, line, symbol, message, detail)
+
+
+def _tracerish_names(project: Project, mi, func) -> set[str]:
+    """Local names (conservatively) holding traced array values: assigned
+    from jnp/lax/jax.* calls or arithmetic on such names. Function
+    parameters are NOT assumed traced (they are often static configs) —
+    an under-approximation by design."""
+    names: set[str] = set()
+
+    def value_is_tracer(node: ast.expr) -> bool:
+        if isinstance(node, ast.Call):
+            return _is_jnpish(project.dotted_resolved(mi, node.func))
+        if isinstance(node, ast.Name):
+            return node.id in names
+        if isinstance(node, ast.BinOp):
+            return value_is_tracer(node.left) or value_is_tracer(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return value_is_tracer(node.operand)
+        if isinstance(node, ast.Compare):
+            return value_is_tracer(node.left) or any(
+                value_is_tracer(c) for c in node.comparators
+            )
+        if isinstance(node, ast.Subscript):
+            return value_is_tracer(node.value)
+        if isinstance(node, ast.IfExp):
+            return value_is_tracer(node.body) or value_is_tracer(node.orelse)
+        if isinstance(node, ast.Attribute):
+            # x.shape / x.dtype are static; x.T / x.at results stay traced
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return value_is_tracer(node.value)
+        return False
+
+    stmts = sorted(
+        (n for n in walk_own(func.node)
+         if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))),
+        key=lambda n: (n.lineno, n.col_offset),
+    )
+    for stmt in stmts:
+        value = stmt.value
+        if value is None:
+            continue
+        if not value_is_tracer(value):
+            continue
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        for t in targets:
+            for el in ([t] if not isinstance(t, (ast.Tuple, ast.List)) else t.elts):
+                if isinstance(el, ast.Name):
+                    names.add(el.id)
+    return names
+
+
+def _uses_tracer(project: Project, mi, expr: ast.expr, names: set[str]) -> bool:
+    """Does ``expr`` read a tracer-ish value (skipping static attrs)?"""
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return False
+        return _uses_tracer(project, mi, expr.value, names)
+    if isinstance(expr, ast.Name):
+        return expr.id in names
+    if isinstance(expr, ast.Call):
+        if _is_jnpish(project.dotted_resolved(mi, expr.func)):
+            return True
+        # a method call on a traced receiver (y.mean(), y.any()) yields a
+        # traced value unless the attribute is static metadata
+        if (isinstance(expr.func, ast.Attribute)
+                and expr.func.attr not in _STATIC_ATTRS
+                and _uses_tracer(project, mi, expr.func.value, names)):
+            return True
+        return any(_uses_tracer(project, mi, a, names) for a in expr.args)
+    return any(
+        _uses_tracer(project, mi, child, names)
+        for child in ast.iter_child_nodes(expr)
+        if isinstance(child, ast.expr)
+    )
+
+
+# --- GL001 -------------------------------------------------------------------
+
+
+class HostSyncInJit:
+    CODE = "GL001"
+    NAME = "host-sync-in-jit"
+
+    _SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        em = _Emitter(self.CODE)
+        for qual, root in sorted(project.traced_from.items()):
+            fi = project.funcs.get(qual)
+            if fi is None:
+                continue
+            mi = project.modules[fi.module]
+            path = mi.sf.path
+            tracerish = _tracerish_names(project, mi, fi)
+            reach = f"reachable from jitted entry `{root.split(':', 1)[1]}`"
+            for node in walk_own(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = project.dotted_resolved(mi, node.func) or ""
+                last = resolved.rsplit(".", 1)[-1]
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self._SYNC_ATTRS):
+                    yield em.emit(
+                        path, node.lineno, fi.local,
+                        f"`.{node.func.attr}()` forces a device sync inside "
+                        f"traced code ({reach}); move it outside the jitted "
+                        "path or return the value",
+                        f".{node.func.attr}()",
+                    )
+                elif resolved == "jax.device_get":
+                    yield em.emit(
+                        path, node.lineno, fi.local,
+                        f"`jax.device_get` inside traced code ({reach}) "
+                        "host-syncs every trace; hoist it to the caller",
+                        "jax.device_get",
+                    )
+                elif (resolved.split(".", 1)[0] in ("numpy", "np", "onp")
+                      and last in ("asarray", "array")):
+                    yield em.emit(
+                        path, node.lineno, fi.local,
+                        f"`{resolved}` materialises a traced value on host "
+                        f"({reach}); use jnp, or move the conversion out of "
+                        "the jitted path",
+                        resolved,
+                    )
+                elif resolved in ("float", "int", "bool") and node.args and (
+                    _uses_tracer(project, mi, node.args[0], tracerish)
+                ):
+                    yield em.emit(
+                        path, node.lineno, fi.local,
+                        f"`{resolved}()` on a traced value ({reach}) blocks "
+                        "on the device (ConcretizationError on newer jax); "
+                        "keep it an array or sync outside the jitted path",
+                        f"{resolved}()",
+                    )
+                elif resolved == "print":
+                    yield em.emit(
+                        path, node.lineno, fi.local,
+                        f"`print` inside traced code ({reach}) runs at trace "
+                        "time only (or syncs under jit); use jax.debug.print",
+                        "print",
+                    )
+
+
+# --- GL002 -------------------------------------------------------------------
+
+
+class RecompileHazard:
+    CODE = "GL002"
+    NAME = "recompile-hazard"
+
+    _MUTABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set)
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        em = _Emitter(self.CODE)
+        yield from self._jit_in_loop(project, em)
+        yield from self._jit_call_hazards(project, em)
+        yield from self._branch_on_tracer(project, em)
+
+    def _jit_in_loop(self, project: Project, em: _Emitter) -> Iterator[Finding]:
+        for mi in project.modules.values():
+            for fi in mi.funcs.values():
+                loops: list[ast.AST] = []
+
+                def visit(node: ast.AST) -> Iterator[Finding]:
+                    for child in ast.iter_child_nodes(node):
+                        if isinstance(child, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.ClassDef)):
+                            continue
+                        is_loop = isinstance(child, (ast.For, ast.While,
+                                                     ast.AsyncFor))
+                        if (loops and isinstance(child, ast.Call)
+                                and project.dotted_resolved(mi, child.func)
+                                in ("jax.jit", "jit", "pjit", "jax.pjit")):
+                            yield em.emit(
+                                mi.sf.path, child.lineno, fi.local,
+                                "`jax.jit` inside a loop builds a fresh "
+                                "jitted callable (and cache entry) every "
+                                "iteration — hoist it out of the loop",
+                                "jit-in-loop",
+                            )
+                        if is_loop:
+                            loops.append(child)
+                        yield from visit(child)
+                        if is_loop:
+                            loops.pop()
+
+                yield from visit(fi.node)
+
+    def _jit_call_hazards(self, project: Project, em: _Emitter) -> Iterator[Finding]:
+        for jc in project.jit_calls:
+            mi = project.modules[jc.module]
+            symbol = jc.func.local if jc.func is not None else ""
+            fn_node = unwrap_partial(jc.node.args[0]) if jc.node.args else None
+            if jc.func is not None and isinstance(fn_node, ast.Lambda):
+                yield em.emit(
+                    mi.sf.path, jc.node.lineno, symbol,
+                    "jit of a lambda inside a function body: the lambda is "
+                    "a fresh object per call, so the jit cache never hits "
+                    "and every invocation recompiles — define the function "
+                    "once (module level or cached factory)",
+                    "jit-of-lambda",
+                )
+            if jc.target is not None and (jc.static_argnums or jc.static_argnames):
+                args = jc.target.node.args
+                params = list(args.posonlyargs) + list(args.args)
+                defaults = list(args.defaults)
+                # defaults align to the tail of the positional params
+                default_of = dict(
+                    zip([p.arg for p in params[len(params) - len(defaults):]],
+                        defaults)
+                )
+                static_names = set(jc.static_argnames)
+                for i in jc.static_argnums:
+                    if 0 <= i < len(params):
+                        static_names.add(params[i].arg)
+                for name in sorted(static_names):
+                    d = default_of.get(name)
+                    if isinstance(d, self._MUTABLE_DEFAULTS):
+                        yield em.emit(
+                            mi.sf.path, jc.node.lineno, symbol,
+                            f"static arg `{name}` of `{jc.target.local}` has "
+                            "a non-hashable (mutable) default: jit static "
+                            "args must hash, and a per-call-fresh object "
+                            "recompiles every call",
+                            f"static-unhashable:{name}",
+                        )
+
+    def _branch_on_tracer(self, project: Project, em: _Emitter) -> Iterator[Finding]:
+        for qual in sorted(project.traced_from):
+            fi = project.funcs.get(qual)
+            if fi is None:
+                continue
+            mi = project.modules[fi.module]
+            tracerish = _tracerish_names(project, mi, fi)
+            if not tracerish:
+                continue
+            for node in walk_own(fi.node):
+                cond = None
+                kind = ""
+                if isinstance(node, (ast.If, ast.While)):
+                    cond, kind = node.test, type(node).__name__.lower()
+                elif isinstance(node, ast.IfExp):
+                    cond, kind = node.test, "conditional expression"
+                elif isinstance(node, ast.Assert):
+                    cond, kind = node.test, "assert"
+                if cond is None or not _uses_tracer(project, mi, cond, tracerish):
+                    continue
+                yield em.emit(
+                    mi.sf.path, node.lineno, fi.local,
+                    f"Python `{kind}` on a traced value inside traced code: "
+                    "concretizes the tracer (error or silent recompile per "
+                    "branch) — use jnp.where / lax.cond / lax.select",
+                    f"branch-on-tracer:{kind}",
+                )
+
+
+# --- GL003 -------------------------------------------------------------------
+
+
+class DonationReuse:
+    CODE = "GL003"
+    NAME = "donation-reuse"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        em = _Emitter(self.CODE)
+        for mi in project.modules.values():
+            module_donors = self._donors(project, mi, mi.sf.tree.body)
+            # module-level straight-line use
+            yield from self._check_scope(
+                project, mi, None, mi.sf.tree.body, dict(module_donors), em
+            )
+            for fi in mi.funcs.values():
+                body = list(getattr(fi.node, "body", []))
+                donors = dict(module_donors)
+                donors.update(self._donors(project, mi, body))
+                yield from self._check_scope(project, mi, fi, body, donors, em)
+
+    def _donors(self, project: Project, mi, body: list[ast.stmt]
+                ) -> dict[str, tuple[int, ...]]:
+        """name -> donated argnums, for ``name = jax.jit(f, donate_argnums=...)``."""
+        donors: dict[str, tuple[int, ...]] = {}
+        for stmt in body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            if project.dotted_resolved(mi, stmt.value.func) not in (
+                "jax.jit", "jit", "pjit", "jax.pjit"
+            ):
+                continue
+            donate = ()
+            for kw in stmt.value.keywords:
+                if kw.arg == "donate_argnums":
+                    from tony_tpu.analysis.callgraph import _const_index_tuple
+
+                    donate = _const_index_tuple(kw.value)
+            if donate:
+                donors[stmt.targets[0].id] = donate
+        return donors
+
+    def _check_scope(self, project: Project, mi, fi, body: list[ast.stmt],
+                     donors: dict[str, tuple[int, ...]], em: _Emitter
+                     ) -> Iterator[Finding]:
+        if not donors:
+            return
+        symbol = fi.local if fi is not None else ""
+        stmts = self._linear(body)
+        for pos, stmt in enumerate(stmts):
+            for call in self._own_calls(stmt):
+                name = call.func.id if isinstance(call.func, ast.Name) else None
+                if name not in donors:
+                    continue
+                for i in donors[name]:
+                    if i >= len(call.args):
+                        continue
+                    arg = dotted(call.args[i])
+                    if arg is None:
+                        continue
+                    yield from self._scan_after(
+                        stmts, pos, stmt, arg, name, mi, symbol, em
+                    )
+
+    def _own_calls(self, stmt: ast.stmt) -> Iterator[ast.Call]:
+        """Call nodes belonging to ``stmt`` itself. For compound statements
+        only the header expressions count — their nested statements appear
+        separately in the linearized list, where their own rebind handling
+        (``state = step(state, b)`` in a loop body) applies."""
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            exprs: list[ast.expr] = [stmt.iter]
+        elif isinstance(stmt, (ast.While, ast.If)):
+            exprs = [stmt.test]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            exprs = [i.context_expr for i in stmt.items]
+        elif isinstance(stmt, (ast.Try, *(
+            (ast.TryStar,) if hasattr(ast, "TryStar") else ()
+        ))):
+            exprs = []
+        else:
+            yield from (n for n in ast.walk(stmt) if isinstance(n, ast.Call))
+            return
+        for e in exprs:
+            yield from (n for n in ast.walk(e) if isinstance(n, ast.Call))
+
+    def _linear(self, body: list[ast.stmt]) -> list[ast.stmt]:
+        """Flatten compound statements into source order, keeping each
+        simple statement whole. Nested function/class definitions are their
+        own scopes and are NOT flattened in — a donation in one function
+        must not taint reads in another."""
+        out: list[ast.stmt] = []
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            out.append(stmt)
+            for attr in ("body", "orelse", "finalbody"):
+                out.extend(self._linear(getattr(stmt, attr, []) or []))
+            for h in getattr(stmt, "handlers", []) or []:
+                out.extend(self._linear(h.body))
+        return out
+
+    def _rebinds(self, stmt: ast.stmt, name: str) -> bool:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            els = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            if any(dotted(el) == name for el in els):
+                return True
+        return False
+
+    def _reads(self, stmt: ast.stmt, name: str, skip_call: ast.Call | None
+               ) -> ast.AST | None:
+        skip = set()
+        if skip_call is not None:
+            skip = {id(n) for n in ast.walk(skip_call)}
+        for node in ast.walk(stmt):
+            if id(node) in skip:
+                continue
+            if dotted(node) == name and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                # the outermost node of an attr chain carries Load ctx
+                return node
+        return None
+
+    def _scan_after(self, stmts, pos, call_stmt, arg: str, donor: str,
+                    mi, symbol: str, em: _Emitter) -> Iterator[Finding]:
+        # `x = donor(x)`: the rebind makes later reads safe
+        if self._rebinds(call_stmt, arg):
+            return
+        for later in stmts[pos + 1:]:
+            if later.lineno <= call_stmt.lineno:
+                continue
+            read = self._reads(later, arg, None)
+            if read is not None:
+                yield em.emit(
+                    mi.sf.path, later.lineno, symbol,
+                    f"`{arg}` was donated to `{donor}` (donate_argnums) and "
+                    "is read afterwards: the buffer may already be reused — "
+                    "rebind the result or drop the donation",
+                    f"donated:{donor}:{arg}",
+                )
+                return
+            if self._rebinds(later, arg):
+                return
+
+
+# --- GL004 -------------------------------------------------------------------
+
+
+_LOCK_ATTR_RE = re.compile(r"(?:^|_)(?:lock|mutex)$")
+_LOCK_CALL_RE = re.compile(r"(?:^|_)locked$")
+_QUEUEISH_RE = re.compile(r"(?:^|_)(?:q|queue|notifications|inbox)$")
+_RPCISH = {"backend", "client", "_client", "stub", "channel", "session_client"}
+_FILEISH_RE = re.compile(r"(?:^|_)(?:f|fh|fp|file|sock)$")
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "sleep": "sleep",
+    "os.replace": "file I/O (os.replace)",
+    "os.rename": "file I/O (os.rename)",
+    "os.makedirs": "file I/O (os.makedirs)",
+    "shutil.copy": "file I/O", "shutil.copytree": "file I/O",
+    "shutil.rmtree": "file I/O",
+    "subprocess.run": "subprocess", "subprocess.check_output": "subprocess",
+    "subprocess.check_call": "subprocess", "subprocess.Popen": "subprocess",
+    "socket.create_connection": "network I/O",
+    "open": "file I/O (open)",
+    "json.dump": "file I/O (json.dump)",
+    "json.load": "file I/O (json.load)",
+}
+
+
+class LockDiscipline:
+    CODE = "GL004"
+    NAME = "lock-discipline"
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        em = _Emitter(self.CODE)
+        # lock-order edges: (lockA, lockB) -> first location
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        for mi in project.modules.values():
+            for fi in mi.funcs.values():
+                yield from self._check_func(project, mi, fi, em, edges)
+        yield from self._inversions(edges, em)
+
+    # lock identity: "<module-tail>:<attr text minus self.>"
+    def _lock_id(self, mi, expr: ast.expr) -> str | None:
+        node = expr.func if isinstance(expr, ast.Call) else expr
+        name = dotted(node)
+        if name is None:
+            return None
+        last = name.rsplit(".", 1)[-1]
+        if isinstance(expr, ast.Call):
+            if not _LOCK_CALL_RE.search(last):
+                return None
+        elif not _LOCK_ATTR_RE.search(last):
+            return None
+        text = name[5:] if name.startswith("self.") else name
+        modtail = mi.modname.rsplit(".", 1)[-1]
+        return f"{modtail}:{text}"
+
+    def _check_func(self, project: Project, mi, fi, em: _Emitter,
+                    edges: dict) -> Iterator[Finding]:
+        held: list[str] = []
+
+        def visit_block(nodes) -> Iterator[Finding]:
+            for child in nodes:
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    locks_here: list[str] = []
+                    for item in child.items:
+                        # the context expr evaluates at acquisition time —
+                        # scan it under the locks held SO FAR (a lock's own
+                        # manager taking its lock is not a self-deadlock)
+                        if held:
+                            for call in (n for n in ast.walk(item.context_expr)
+                                         if isinstance(n, ast.Call)):
+                                lid = self._lock_id(mi, call)
+                                if lid is None and self._lock_id(
+                                    mi, call.func
+                                ) is None:
+                                    yield from self._check_call(
+                                        project, mi, fi, call, held[-1],
+                                        em, edges, depth=0,
+                                    )
+                        lid = self._lock_id(mi, item.context_expr)
+                        if lid is not None:
+                            if held:
+                                edges.setdefault(
+                                    (held[-1], lid),
+                                    (mi.sf.path, child.lineno, fi.local),
+                                )
+                            locks_here.append(lid)
+                    held.extend(locks_here)
+                    yield from visit_block(child.body)
+                    for _ in locks_here:
+                        held.pop()
+                    yield from visit_block(child.orelse if hasattr(child, "orelse") else [])
+                    continue
+                if held and isinstance(child, ast.Call):
+                    yield from self._check_call(
+                        project, mi, fi, child, held[-1], em, edges, depth=0
+                    )
+                yield from visit_block(ast.iter_child_nodes(child))
+
+        yield from visit_block(ast.iter_child_nodes(fi.node))
+
+    def _blocking_reason(self, project: Project, mi, call: ast.Call) -> str | None:
+        resolved = project.dotted_resolved(mi, call.func) or ""
+        if resolved in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[resolved]
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        recv = dotted(call.func.value) or ""
+        recv_last = recv.rsplit(".", 1)[-1]
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        nonblocking = any(
+            kw.arg == "block" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+            for kw in call.keywords
+        )
+        if attr == "join" and not call.args and not has_timeout:
+            return "unbounded .join()"
+        if attr == "wait" and not call.args and not has_timeout:
+            return "unbounded .wait()"
+        if (attr == "get" and _QUEUEISH_RE.search(recv_last)
+                and not has_timeout and not nonblocking and not call.args):
+            return "blocking queue .get() without timeout"
+        if attr in ("read", "write", "flush", "readline") and _FILEISH_RE.search(recv_last):
+            return f"file I/O (.{attr})"
+        parts = set(recv.replace("self.", "").split("."))
+        if parts & _RPCISH:
+            return f"RPC/subprocess-backed call ({recv}.{attr})"
+        return None
+
+    def _check_call(self, project: Project, mi, fi, call: ast.Call,
+                    lock: str, em: _Emitter, edges: dict, depth: int
+                    ) -> Iterator[Finding]:
+        reason = self._blocking_reason(project, mi, call)
+        name = dotted(call.func) or "<call>"
+        if reason is not None:
+            yield em.emit(
+                mi.sf.path, call.lineno, fi.local,
+                f"{reason} while holding `{lock}`: the lock is held across "
+                "a call that can block — move the blocking work outside "
+                "the locked region",
+                f"{lock}:{name.replace('self.', '')}",
+            )
+            return
+        if depth >= 1:
+            return
+        # one hop into analyzed callees: their direct blocking calls and
+        # lock acquisitions count against the held lock
+        target = project.resolve_callable(mi, fi, call.func)
+        if target is None:
+            return
+        tmi = project.modules[target.module]
+        for node in walk_own(target.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = self._lock_id(tmi, item.context_expr)
+                    if lid is not None:
+                        edges.setdefault(
+                            (lock, lid), (mi.sf.path, call.lineno, fi.local)
+                        )
+            if not isinstance(node, ast.Call):
+                continue
+            reason = self._blocking_reason(project, tmi, node)
+            if reason is not None:
+                yield em.emit(
+                    mi.sf.path, call.lineno, fi.local,
+                    f"`{name}` does {reason} while `{lock}` is held "
+                    f"(via {target.local} at {tmi.sf.path}:{node.lineno}) — "
+                    "move the blocking work outside the locked region",
+                    f"{lock}:via:{target.local}",
+                )
+                return
+
+    def _inversions(self, edges: dict, em: _Emitter) -> Iterator[Finding]:
+        seen = set()
+        for (a, b), (path, line, symbol) in sorted(edges.items()):
+            if a == b or (b, a) not in edges or (b, a) in seen:
+                continue
+            seen.add((a, b))
+            opath, oline, _ = edges[(b, a)]
+            yield em.emit(
+                path, line, symbol,
+                f"lock-order inversion: `{a}` is taken before `{b}` here, "
+                f"but `{b}` before `{a}` at {opath}:{oline} — two threads "
+                "can deadlock; pick one global order",
+                f"inversion:{min(a, b)}:{max(a, b)}",
+            )
+
+
+# --- GL005 -------------------------------------------------------------------
+
+
+class DisarmedHookCost:
+    CODE = "GL005"
+    NAME = "disarmed-hook-cost"
+
+    _GUARD_HINTS = ("tracer", "armed", "injector", "enabled")
+
+    def _is_seam(self, resolved: str | None) -> bool:
+        if not resolved:
+            return False
+        parts = resolved.split(".")
+        if parts[-1] == "chaos_hook":
+            return True
+        if parts[-1] in ("span", "instant", "sampled_span"):
+            # module-level seam (trace.span); method calls on a tracer
+            # object obtained after the armed check are fine
+            return len(parts) == 1 or parts[-2] in ("trace", "chaos")
+        return False
+
+    def _expensive(self, node: ast.expr) -> str | None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                return f"call `{dotted(n.func) or '<expr>'}(...)`"
+            if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+                return "a comprehension"
+        return None
+
+    def _guarded(self, guards: list[ast.expr]) -> bool:
+        for g in guards:
+            try:
+                text = ast.unparse(g).lower()
+            except Exception:
+                continue
+            if any(h in text for h in self._GUARD_HINTS):
+                return True
+        return False
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        em = _Emitter(self.CODE)
+        for mi in project.modules.values():
+            # hook *implementation* modules are exempt: the seam body runs
+            # after its own armed check by construction
+            if mi.modname.endswith(("obs.trace", "chaos.faults")):
+                continue
+            for fi in mi.funcs.values():
+                yield from self._check_func(project, mi, fi, em)
+
+    def _check_func(self, project: Project, mi, fi, em: _Emitter
+                    ) -> Iterator[Finding]:
+        guards: list[ast.expr] = []
+
+        def visit(node: ast.AST) -> Iterator[Finding]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                pushed = False
+                if isinstance(child, (ast.If, ast.While)):
+                    guards.append(child.test)
+                    pushed = True
+                if isinstance(child, ast.Call) and self._is_seam(
+                    project.dotted_resolved(mi, child.func)
+                ):
+                    seam = dotted(child.func) or "hook"
+                    for arg in list(child.args) + [
+                        kw.value for kw in child.keywords
+                    ]:
+                        why = self._expensive(arg)
+                        if why is None:
+                            continue
+                        if self._guarded(guards):
+                            break
+                        yield em.emit(
+                            mi.sf.path, child.lineno, fi.local,
+                            f"`{seam}(...)` argument contains {why}, "
+                            "evaluated even when the hook is disarmed — "
+                            "guard the call site (if tracer/injector is "
+                            "armed) or precompute cheap values; the "
+                            "disarmed hook must stay one global load "
+                            "(docs/PERF.md disarmed-hook guard)",
+                            f"{seam}",
+                        )
+                        break
+                yield from visit(child)
+                if pushed:
+                    guards.pop()
+
+        yield from visit(fi.node)
+
+
+CHECKERS = [HostSyncInJit, RecompileHazard, DonationReuse, LockDiscipline,
+            DisarmedHookCost]

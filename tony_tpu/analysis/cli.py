@@ -1,0 +1,94 @@
+"""`tony lint` / scripts/lint.py driver: lint paths against a baseline.
+
+Exit codes: 0 = no new findings, 1 = new findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tony_tpu.analysis.core import (
+    Baseline, all_checkers, default_baseline_path, lint_paths,
+)
+
+
+def add_lint_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "paths", nargs="*", default=["tony_tpu"],
+        help="files/directories to lint (default: tony_tpu)",
+    )
+    p.add_argument(
+        "--baseline", default="",
+        help="baseline JSON (default: graft_lint_baseline.json found by "
+             "walking up from the first path); 'none' disables",
+    )
+    p.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        dest="fmt", help="output format",
+    )
+    p.add_argument(
+        "--select", default="",
+        help="comma-separated checker codes to run (default: all)",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline file with the current findings "
+             "(existing justifications are kept)",
+    )
+
+
+def run_lint(args: argparse.Namespace) -> int:
+    paths = args.paths or ["tony_tpu"]
+    select = [c.strip() for c in args.select.split(",") if c.strip()]
+    known = {c.CODE for c in all_checkers()}
+    bad = set(select) - known
+    if bad:
+        print(f"unknown checker code(s): {', '.join(sorted(bad))} "
+              f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+        return 2
+    if args.baseline == "none":
+        baseline = Baseline({}, "")
+    else:
+        baseline = Baseline.load(args.baseline or default_baseline_path(paths))
+    new, old = lint_paths(paths, baseline, select=select)
+    if args.update_baseline:
+        if not baseline.path:
+            print("--update-baseline needs --baseline PATH", file=sys.stderr)
+            return 2
+        baseline.save(findings=new + old)
+        print(f"wrote {baseline.path} ({len(new) + len(old)} entries)")
+        return 0
+    if args.fmt == "json":
+        print(json.dumps({
+            "new": [f.to_json() for f in new],
+            "baselined": [f.to_json() for f in old],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if old:
+            print(f"# {len(old)} baselined finding(s) suppressed "
+                  f"({baseline.path})", file=sys.stderr)
+        if new:
+            print(f"\n{len(new)} new finding(s); fix, suppress inline "
+                  "(# graft-lint: disable=CODE), or baseline with a "
+                  "justification (docs/ANALYSIS.md)", file=sys.stderr)
+        else:
+            print("graft-lint: clean", file=sys.stderr)
+    return 1 if new else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graft-lint",
+        description="JAX-aware + concurrency-aware static analysis "
+                    "(docs/ANALYSIS.md)",
+    )
+    add_lint_args(p)
+    return run_lint(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

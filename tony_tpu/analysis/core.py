@@ -1,0 +1,283 @@
+"""graft-lint engine: findings, suppressions, baseline, checker registry.
+
+The analysis is stdlib-``ast`` only (no jax import, no third-party deps):
+executor images for non-JAX frameworks and bare CI runners can lint the
+tree. Each checker is a class with a ``CODE`` (``GLxxx``), registered in
+``CHECKERS``; checkers consume the shared :class:`Project` (parsed modules
++ cross-module call graph, analysis/callgraph.py) and yield
+:class:`Finding`\\ s.
+
+Three escape hatches, in order of preference:
+
+- fix the code (the point of the tool);
+- inline ``# graft-lint: disable=GL004`` on the offending line (or a
+  standalone comment on the line above) with a justifying comment — for
+  load-bearing exceptions the code should document where they live;
+- a committed baseline file (``graft_lint_baseline.json``) keyed by
+  line-number-independent fingerprints — for grandfathered findings that
+  are tracked but not yet fixed. ``scripts/lint.py --update-baseline``
+  rewrites it; new findings beyond the baseline fail the tier-1 gate
+  (tests/test_lint.py::test_codebase_is_lint_clean).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*disable=([A-Z0-9,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*graft-lint:\s*disable-file=([A-Z0-9,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``fingerprint`` is line-number-independent (code +
+    file + enclosing symbol + a stable detail tag) so a baseline entry
+    survives unrelated edits to the file."""
+
+    code: str      # "GL001"
+    path: str      # posix path as given to the linter (repo-relative in CI)
+    line: int
+    symbol: str    # enclosing function qualname ("" = module level)
+    message: str
+    detail: str = ""  # stable tag for the fingerprint (e.g. offending call)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}|{self.path}|{self.symbol}|{self.detail}"
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.code}{sym} {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "code": self.code, "path": self.path, "line": self.line,
+            "symbol": self.symbol, "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+
+class Baseline:
+    """Committed grandfathered findings: fingerprint -> justification."""
+
+    def __init__(self, entries: dict[str, str] | None = None, path: str = ""):
+        self.entries = dict(entries or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, encoding="utf-8") as f:
+                raw = json.load(f)
+        except FileNotFoundError:
+            return cls({}, path)
+        entries = {
+            e["fingerprint"]: e.get("justification", "")
+            for e in raw.get("findings", [])
+            if isinstance(e, dict) and e.get("fingerprint")
+        }
+        return cls(entries, path)
+
+    def save(self, path: str | None = None,
+             findings: Iterable[Finding] = ()) -> None:
+        """Rewrite with the given findings, keeping existing justifications
+        (new entries get a placeholder that review should replace)."""
+        out = {
+            "_comment": (
+                "graft-lint baseline: grandfathered findings, keyed by "
+                "line-independent fingerprints. Every entry needs a "
+                "justification; prefer fixing or inline suppression "
+                "(docs/ANALYSIS.md)."
+            ),
+            "findings": [
+                {
+                    "fingerprint": f.fingerprint,
+                    "justification": self.entries.get(
+                        f.fingerprint, "TODO: justify or fix"
+                    ),
+                    "where": f"{f.path}:{f.symbol or '<module>'}",
+                    "message": f.message,
+                }
+                for f in sorted(findings, key=lambda f: f.fingerprint)
+            ],
+        }
+        with open(path or self.path, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=1, sort_keys=False)
+            f.write("\n")
+
+    def covers(self, finding: Finding) -> bool:
+        return finding.fingerprint in self.entries
+
+
+@dataclass
+class SourceFile:
+    """One parsed file plus its suppression tables."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    modname: str
+    # line -> codes suppressed on that line (incl. carried from a
+    # standalone comment line above); {"*"} = all codes
+    line_suppress: dict[int, set[str]] = field(default_factory=dict)
+    file_suppress: set[str] = field(default_factory=set)
+
+    def suppressed(self, code: str, line: int) -> bool:
+        if code in self.file_suppress or "*" in self.file_suppress:
+            return True
+        codes = self.line_suppress.get(line, ())
+        return code in codes or "*" in codes
+
+
+def _parse_suppressions(sf: SourceFile) -> None:
+    lines = sf.source.splitlines()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_FILE_RE.search(text)
+        if m:
+            sf.file_suppress.update(
+                c.strip() for c in m.group(1).split(",") if c.strip()
+            )
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+        sf.line_suppress.setdefault(i, set()).update(codes)
+        if text.lstrip().startswith("#"):
+            # standalone comment: applies to the next line too
+            sf.line_suppress.setdefault(i + 1, set()).update(codes)
+
+
+def _module_name(path: str) -> str:
+    """Dotted module name by walking up through __init__.py packages
+    (bare stem for loose files — e.g. test fixture dirs)."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    if parts[0] == "__init__":
+        parts = parts[1:] or parts
+    return ".".join(reversed(parts))
+
+
+def _anchor_for(absp: str) -> str:
+    """Display-path anchor: the repo root (the directory holding
+    ``graft_lint_baseline.json``, walking up) when there is one, else the
+    path's parent. Anchoring at the repo root makes fingerprints identical
+    whether the whole tree, a subdirectory, or a single file is linted —
+    otherwise baseline entries recorded from ``tony lint tony_tpu/`` would
+    read as NEW findings when a developer lints one changed file."""
+    d = absp if os.path.isdir(absp) else os.path.dirname(absp)
+    while True:
+        if os.path.isfile(os.path.join(d, "graft_lint_baseline.json")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.path.dirname(absp)
+        d = parent
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[tuple[str, str]]:
+    """Yield (absolute path, display path). Display paths are relative to
+    the repo root when one is identifiable, else the linted root's parent
+    (``tony_tpu/cluster/lease.py`` no matter the cwd or the argument
+    shape), so baseline fingerprints are stable across checkouts and
+    across whole-tree vs single-file invocations."""
+    for p in paths:
+        absp = os.path.abspath(p)
+        if os.path.isfile(absp):
+            if absp.endswith(".py"):
+                yield absp, os.path.relpath(
+                    absp, _anchor_for(absp)
+                ).replace(os.sep, "/")
+        else:
+            anchor = _anchor_for(absp)
+            for root, dirs, files in os.walk(absp):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git") and not d.startswith(".")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        full = os.path.join(root, name)
+                        yield full, os.path.relpath(full, anchor).replace(
+                            os.sep, "/"
+                        )
+
+
+def load_project(paths: Iterable[str]):
+    """Parse every .py under ``paths`` into a Project (analysis/callgraph.py)
+    with the cross-module call graph and jit-reachability precomputed."""
+    from tony_tpu.analysis.callgraph import Project
+
+    sources: list[SourceFile] = []
+    for path, display in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError):
+            continue  # unreadable/unparsable files are not this tool's job
+        sf = SourceFile(path=display, source=src, tree=tree,
+                        modname=_module_name(path))
+        _parse_suppressions(sf)
+        sources.append(sf)
+    return Project(sources)
+
+
+def all_checkers() -> list:
+    from tony_tpu.analysis import checkers
+
+    return [cls() for cls in checkers.CHECKERS]
+
+
+def run_checkers(project, checkers: Iterable | None = None,
+                 select: Iterable[str] = ()) -> list[Finding]:
+    """Run checkers over a loaded project, honouring inline suppressions.
+    ``select`` restricts to the given codes (empty = all)."""
+    selected = set(select)
+    out: list[Finding] = []
+    for checker in (checkers if checkers is not None else all_checkers()):
+        if selected and checker.CODE not in selected:
+            continue
+        for f in checker.run(project):
+            sf = project.by_path.get(f.path)
+            if sf is not None and sf.suppressed(f.code, f.line):
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out
+
+
+def lint_paths(paths: Iterable[str], baseline: Baseline | None = None,
+               select: Iterable[str] = ()) -> tuple[list[Finding], list[Finding]]:
+    """Lint ``paths``; returns (new_findings, baselined_findings)."""
+    project = load_project(paths)
+    findings = run_checkers(project, select=select)
+    if baseline is None:
+        return findings, []
+    new = [f for f in findings if not baseline.covers(f)]
+    old = [f for f in findings if baseline.covers(f)]
+    return new, old
+
+
+def default_baseline_path(paths: Iterable[str]) -> str:
+    """``graft_lint_baseline.json`` next to the first linted path's repo
+    root: walk up from the first path looking for the file, else cwd."""
+    first = next(iter(paths), ".")
+    d = os.path.abspath(first if os.path.isdir(first) else os.path.dirname(first) or ".")
+    while True:
+        cand = os.path.join(d, "graft_lint_baseline.json")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.path.join(os.getcwd(), "graft_lint_baseline.json")
+        d = parent

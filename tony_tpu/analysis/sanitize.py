@@ -1,0 +1,137 @@
+"""Runtime sanitizer: the dynamic twin of graft-lint (GRAFT_SANITIZE=1).
+
+Static analysis (GL001/GL002) catches host syncs and recompile hazards it
+can *prove* from the source; this module catches the rest at runtime, the
+way tsan complements a lock-discipline lint:
+
+- **transfer guard** — ``jax.transfer_guard_device_to_host("disallow")``
+  over the steady-state loop: any IMPLICIT device-to-host transfer (a
+  stray ``np.asarray``/``float()`` on a device array) raises instead of
+  silently stalling the pipeline. Explicit syncs (``jax.device_get``, the
+  log-boundary reads) stay allowed — the contract is "every sync is
+  spelled out", not "no syncs". The guard config is thread-local, so the
+  prefetch/telemetry daemon threads are unaffected. NOTE: on the CPU
+  backend jax skips the guard (no cross-device transfer happens), so this
+  arm bites on TPU/GPU only.
+- **compile watchdog** — counts XLA backend compiles (the
+  ``/jax/core/compile/backend_compile_duration`` monitoring event) inside
+  the guarded region. Steady state means ZERO new compiles: a recompile
+  per step is the classic silent 100x (GL002's dynamic shadow). Budget
+  overruns raise :class:`SanitizeError` at the first excess compile, with
+  the count in the message.
+
+Wired into ``fit()`` (steady state: after the first step resolved) and
+``Engine.run()`` under ``GRAFT_SANITIZE=1``; both are no-ops otherwise.
+``GRAFT_SANITIZE_MAX_COMPILES`` (default 0) loosens the budget for loops
+that legitimately grow signatures mid-run (e.g. an engine trace that
+crosses a cache-capacity doubling).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+ENV_FLAG = "GRAFT_SANITIZE"
+ENV_MAX_COMPILES = "GRAFT_SANITIZE_MAX_COMPILES"
+
+_counter_lock = threading.Lock()
+_compile_events = 0
+_listener_installed = False
+
+
+class SanitizeError(RuntimeError):
+    """A sanitized loop broke its contract (excess compiles)."""
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") == "1"
+
+
+def _max_compiles(default: int = 0) -> int:
+    try:
+        return int(os.environ.get(ENV_MAX_COMPILES, "") or default)
+    except ValueError:
+        return default
+
+
+def _on_duration_event(event: str, duration: float, **_kw) -> None:
+    global _compile_events
+    if event == "/jax/core/compile/backend_compile_duration":
+        with _counter_lock:
+            _compile_events += 1
+
+
+def _ensure_listener() -> None:
+    """Install the (permanent, cheap) monitoring listener once per process.
+    jax.monitoring has no per-listener removal, so the counter always runs
+    and watchdogs compare snapshots of it."""
+    global _listener_installed
+    with _counter_lock:
+        if _listener_installed:
+            return
+        _listener_installed = True
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration_event)
+
+
+def compile_count() -> int:
+    """Process-wide backend-compile count since the listener was armed."""
+    _ensure_listener()
+    with _counter_lock:
+        return _compile_events
+
+
+class CompileWatchdog:
+    """Snapshot-compare compile counter for a region. ``check()`` raises
+    :class:`SanitizeError` when the region exceeded its budget; call it
+    per iteration (cheap: one int compare) so the failure points at the
+    first offending step, not the end of the run."""
+
+    def __init__(self, budget: int, where: str):
+        self.budget = budget
+        self.where = where
+        self._t0 = compile_count()
+
+    @property
+    def compiles(self) -> int:
+        return compile_count() - self._t0
+
+    def check(self) -> None:
+        n = self.compiles
+        if n > self.budget:
+            raise SanitizeError(
+                f"GRAFT_SANITIZE: {n} XLA compile(s) inside the "
+                f"steady-state {self.where} loop (budget {self.budget}). "
+                "Something retraces per call — look for per-call-fresh "
+                "callables/static args (graft-lint GL002) or growing "
+                "shapes; raise GRAFT_SANITIZE_MAX_COMPILES only if the "
+                "recompile is intended (e.g. a planned capacity change)."
+            )
+
+
+@contextlib.contextmanager
+def sanitized_loop(where: str, max_compiles: int | None = None):
+    """Context manager arming both sanitizer arms around a steady-state
+    loop. Yields the :class:`CompileWatchdog` (or None when disarmed) —
+    the loop should call ``watchdog.check()`` each iteration. The compile
+    budget is also enforced at region exit for loops that cannot call
+    check() conveniently."""
+    if not enabled():
+        yield None
+        return
+    import jax
+
+    budget = _max_compiles(0) if max_compiles is None else max_compiles
+    watchdog = CompileWatchdog(budget, where)
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield watchdog
+    watchdog.check()
+
+
+__all__ = [
+    "CompileWatchdog", "ENV_FLAG", "ENV_MAX_COMPILES", "SanitizeError",
+    "compile_count", "enabled", "sanitized_loop",
+]

@@ -235,6 +235,14 @@ def cmd_trace(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """graft-lint: JAX-aware + concurrency-aware static analysis over the
+    given paths (docs/ANALYSIS.md). Exit 0 = no non-baselined findings."""
+    from tony_tpu.analysis.cli import run_lint
+
+    return run_lint(args)
+
+
 def cmd_rm_status(args: argparse.Namespace) -> int:
     """Inspect (or clean) the shared ResourceManager lease store — the
     `yarn top` analogue for the cross-job arbitration substrate."""
@@ -347,6 +355,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="output path (default <app_dir>/trace.json)",
     )
     s.set_defaults(fn=cmd_trace)
+
+    s = sub.add_parser(
+        "lint",
+        help="run graft-lint static analysis (GL001-GL005: host-sync-in-jit, "
+             "recompile-hazard, donation-reuse, lock-discipline, "
+             "disarmed-hook-cost)",
+    )
+    from tony_tpu.analysis.cli import add_lint_args
+
+    add_lint_args(s)
+    s.set_defaults(fn=cmd_lint)
 
     s = sub.add_parser(
         "rm-status",

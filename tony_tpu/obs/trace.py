@@ -297,6 +297,10 @@ class Tracer:
                 return
             recs = list(self._ring)
             self._ring.clear()
+        # the io lock EXISTS to serialize journal writes (flusher thread vs
+        # close vs pre-SIGKILL emergency flush); span producers never take
+        # it — the ring decouples them — so holding it across file I/O is
+        # the design, not a stall hazard
         with self._io_lock:
             if self._closed:
                 # lost the race with close(): the popped batch can no longer
@@ -306,8 +310,8 @@ class Tracer:
                 return
             try:
                 for r in recs:
-                    self._write_line(r)
-                self._f.flush()
+                    self._write_line(r)  # graft-lint: disable=GL004
+                self._f.flush()  # graft-lint: disable=GL004
             except OSError:
                 with self._lock:
                     self.dropped += len(recs)  # upper bound: some may have landed
@@ -334,13 +338,13 @@ class Tracer:
         renders as running until trace end)."""
         self.flush()
         recs = self._open_records()
-        with self._io_lock:
+        with self._io_lock:  # serializes journal I/O by design (see flush)
             if self._closed:
                 return
             try:
                 for r in recs:
-                    self._write_line(r)
-                self._f.flush()
+                    self._write_line(r)  # graft-lint: disable=GL004
+                self._f.flush()  # graft-lint: disable=GL004
             except OSError:
                 with self._lock:
                     self.dropped += len(recs)
@@ -354,8 +358,37 @@ class Tracer:
             except Exception:
                 pass
 
-    def close(self) -> None:
+    def close(self, join_timeout_s: float = 2.0) -> None:
+        """Stop the flusher, JOIN it (bounded), flush the residual ring,
+        then journal still-open spans and close the file.
+
+        The join is the shutdown contract for short-lived processes (CLI
+        tools, chaos-killed children that catch the signal and exit): a
+        daemon flusher abandoned mid-write at interpreter teardown would
+        tear its current line AND make the subsequent residual flush race
+        ``_closed`` — dropping the last window of spans, exactly the ones
+        a post-mortem needs. Joining first means the drain loop has fully
+        exited before the final flush drains what remains, so nothing is
+        in flight. The timeout is bounded so a wedged disk (hard-mounted
+        FS) can never hang process exit; whatever the wedged thread held
+        is counted in ``dropped``, per the loss-accounting contract."""
         self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=max(join_timeout_s, 0.0))
+            if thread.is_alive():
+                # the flusher is wedged mid-write (hard-mounted FS) and may
+                # hold _io_lock: touching the journal now would block
+                # process exit on that lock — the very hang the bounded
+                # join exists to prevent. Abandon the residual ring
+                # (counted in ``dropped``) and leave the file to the OS.
+                with self._lock:
+                    self.dropped += len(self._ring)
+                    self._ring.clear()
+                # benign race: the wedged writer re-checks _closed under
+                # _io_lock and drops its batch if it ever unwedges
+                self._closed = True
+                return
         try:
             self.flush()
         except Exception:
@@ -368,18 +401,18 @@ class Tracer:
             opens = self._open_records()
         except Exception:
             opens = []
-        with self._io_lock:
+        with self._io_lock:  # serializes journal I/O by design (see flush)
             if self._closed:
                 return
             self._closed = True
             try:
                 for r in opens:
-                    self._write_line(r)
+                    self._write_line(r)  # graft-lint: disable=GL004
             except Exception:
                 pass
             if self.dropped:
                 try:
-                    self._f.write(json.dumps(
+                    self._f.write(json.dumps(  # graft-lint: disable=GL004
                         {"ph": "M", "proc": self.proc, "dropped": self.dropped}
                     ) + "\n")
                 except Exception:

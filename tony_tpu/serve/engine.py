@@ -231,7 +231,9 @@ class Engine:
 
     def submit(self, req: Request) -> int:
         """Queue a request; returns its id (the key into run()'s result)."""
-        plen = int(np.asarray(req.prompt).shape[-1])
+        # np.shape reads metadata only — no device transfer for jax arrays
+        shape = np.shape(req.prompt)
+        plen = int(shape[-1]) if shape else 0
         if plen < 1:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
@@ -337,10 +339,23 @@ class Engine:
         if requests is not None:
             for r in requests:
                 self.submit(r)
-        while self._queue or self.n_live:
-            self.step()
+        self._run_loop()
         done, self._completions = self._completions, {}
         return done
+
+    def _run_loop(self) -> None:
+        """Drain queue + live slots under the runtime sanitizer when armed
+        (GRAFT_SANITIZE=1): implicit D2H transfers and steady-state
+        compiles raise (analysis/sanitize.py). A cold engine compiles per
+        prefill bucket / cache capacity by design — sanitize a *warmed*
+        engine, or budget via GRAFT_SANITIZE_MAX_COMPILES."""
+        from tony_tpu.analysis import sanitize
+
+        with sanitize.sanitized_loop("decode") as watchdog:
+            while self._queue or self.n_live:
+                self.step()
+                if watchdog is not None:
+                    watchdog.check()
 
     # --- admission ------------------------------------------------------------
 
@@ -361,7 +376,9 @@ class Engine:
         if qspan is not None:
             qspan.end(slot=slot)
         self._g_queue.set(len(self._queue))
-        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        # explicit D2H for device-array prompts (no-op for lists/np):
+        # transfer-guard-clean under GRAFT_SANITIZE
+        prompt = np.asarray(jax.device_get(req.prompt), np.int32).reshape(-1)
         plen = len(prompt)
         bucket = self._bucket_for(plen)
         with trace.span("serve.prefill", rid=rid, bucket=bucket, slot=slot):
@@ -374,7 +391,9 @@ class Engine:
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
                 jnp.float32(req.top_p), key,
             )
-            tok = int(np.asarray(tok))
+            # EXPLICIT sync: the sampled first token steers admission on
+            # the host (transfer-guard-clean under GRAFT_SANITIZE)
+            tok = int(jax.device_get(tok))
         now = time.perf_counter()
         self.metrics.record_prefill(now - t0, now - self._submit_t[rid])  # popped below
         self._h_ttft.observe(now - self._submit_t[rid])
@@ -498,8 +517,11 @@ class Engine:
             self.cache, self.state, toks = self._get_decode(self.cache.capacity)(
                 self.params, self.cache, self.state
             )
-            toks_np = np.asarray(toks)
-            done_np = np.asarray(self.state.done)
+            # EXPLICIT per-step sync: continuous batching needs the sampled
+            # tokens + done flags on host to steer admission — this is the
+            # engine's one designed sync point per decode step
+            toks_np = jax.device_get(toks)
+            done_np = jax.device_get(self.state.done)
             dt = time.perf_counter() - t0
         self.metrics.record_decode(
             dt, len(live_before), len(live_before), self.serve.slots

@@ -28,6 +28,7 @@ Startup and the steady-state loop are overlapped (docs/PERF.md "Overlap"):
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
@@ -271,7 +272,11 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
         scalars, then log + push. Called AFTER the next step is dispatched,
         so the sync never leaves the device idle."""
         m = snap["metrics"]
-        loss = float(m["loss"])  # device sync point
+        # EXPLICIT device sync point (jax.device_get, not bare float()):
+        # under GRAFT_SANITIZE the steady-state loop runs with implicit
+        # device-to-host transfers disallowed — the log boundary is the
+        # one place a sync is intended, so it is spelled out
+        loss = float(jax.device_get(m["loss"]))
         timer = StepTimer(
             flops_per_token=flops_per_token,
             tokens_per_step=tokens_per_step,
@@ -284,7 +289,7 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             "tokens_per_sec": round(timer.tokens_per_sec, 1),
             "tokens_per_sec_per_chip": round(timer.tokens_per_sec_per_chip, 1),
             "mfu": round(timer.mfu(), 4),
-            "grad_norm": round(float(m["grad_norm"]), 4),
+            "grad_norm": round(float(jax.device_get(m["grad_norm"])), 4),
             "host_blocked_ms_per_step": round(timer.host_blocked_ms_per_step, 2),
         }
         if snap.get("startup"):
@@ -347,6 +352,13 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     )
     from tony_tpu.obs.profiler import annotate
 
+    # runtime sanitizer (GRAFT_SANITIZE=1, analysis/sanitize.py): armed
+    # once the first step has fully resolved — steady state must neither
+    # implicitly host-sync nor compile (docs/ANALYSIS.md "Sanitizer")
+    from tony_tpu.analysis import sanitize
+
+    san_stack = contextlib.ExitStack()
+    watchdog = None
     try:
         for step in range(start_step, cfg.steps):
             t_fetch = time.perf_counter()
@@ -429,13 +441,21 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
                 host_window_s = 0.0
                 if step == start_step:
                     steady_t0 = time.perf_counter()
+                    if sanitize.enabled():
+                        watchdog = san_stack.enter_context(
+                            sanitize.sanitized_loop("fit")
+                        )
+            if watchdog is not None:
+                watchdog.check()  # fail at the offending step, not the end
             if manager is not None and manager.should_save(step + 1):
                 manager.save(step + 1, state)
+        san_stack.close()  # sanitizer covers exactly the steady-state steps
         if pending is not None:
             _emit(pending)
             pending = None
         steady_end = time.perf_counter()  # before checkpoint settling
     finally:
+        san_stack.close()
         close_batches(batches)
     if manager is not None:
         manager.wait()  # settle async saves before checking what exists

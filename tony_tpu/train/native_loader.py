@@ -38,17 +38,20 @@ def _build_dir() -> str:
 
 def _load() -> ctypes.CDLL | None:
     global _lib
+    # the lock's purpose is to serialize the ONE-TIME native build across
+    # threads racing the first loader construction; after that it guards a
+    # cached-handle read. Holding it across the compile is the design.
     with _lock:
         if _lib is not None:
             return _lib
-        lib_path = os.path.join(_build_dir(), _LIB_NAME)
+        lib_path = os.path.join(_build_dir(), _LIB_NAME)  # graft-lint: disable=GL004
         src = os.path.abspath(_SRC)
         if not os.path.exists(src):
             return None
         if (not os.path.exists(lib_path)
                 or os.path.getmtime(lib_path) < os.path.getmtime(src)):
             try:
-                subprocess.run(
+                subprocess.run(  # graft-lint: disable=GL004
                     ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
                      src, "-o", lib_path],
                     check=True, capture_output=True, timeout=120,
