@@ -5,7 +5,11 @@ Prints ONE JSON line:
 
 The reference publishes no throughput numbers (BASELINE.md: "published": {});
 the driver's north star is tokens/sec/chip and >= 45% MFU, so ``vs_baseline``
-reports achieved MFU / 0.45 (1.0 = the north-star target).
+reports achieved MFU / 0.45 (1.0 = the north-star target). MFU is computed
+from the compiled step's measured ``cost_analysis()`` FLOPs (the hand
+formula rides along as ``mfu_formula`` with the ratio reported), and every
+section runs under a phase-scoped ``HbmWatch`` watermark (obs/hbm.py) so
+its HBM numbers are its own, not a cumulative process high-water mark.
 
 The primary line is the 1.35B-param dense train step (the largest dense
 config whose AdamW state + activations fit one v5e's 16GB HBM — Llama-2-7B
@@ -39,64 +43,97 @@ def _fence(x) -> float:
     return float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
 
 
-def _peak_hbm() -> dict:
-    """Device peak-HBM snapshot keyed for bench extras ({} off-TPU).
+def _hbm_watch():
+    """The bench-wide HbmWatch (obs/hbm.py): phase-scoped watermarks per
+    section — each section owns its number (peak_exact says whether it set
+    a new process high-water mark), killing the old cumulative-peak caveat
+    and its `cum_peak_after_moe` workaround."""
+    global _WATCH
+    if _WATCH is None:
+        from tony_tpu.obs.hbm import HbmWatch
 
-    Caveat: peak_bytes_in_use is cumulative per process, so within one
-    bench run a later config's number is max(its own peak, every earlier
-    config's) — the FIRST train_bench in the process (the headline dense
-    config) is the authoritative one."""
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-    except Exception:
-        return {}
-    out = {}
-    if "peak_bytes_in_use" in stats:
-        out["peak_bytes_in_use"] = int(stats["peak_bytes_in_use"])
-        out["peak_hbm_gb"] = round(stats["peak_bytes_in_use"] / 2**30, 2)
-    if "bytes_limit" in stats:
-        out["hbm_limit_gb"] = round(stats["bytes_limit"] / 2**30, 2)
-    return out
+        _WATCH = HbmWatch()
+    return _WATCH
 
 
-def train_bench(cfg, batch: int, seq: int, steps: int, mu_dtype) -> dict:
-    """One sharded train-step benchmark; returns tok/s + MFU + loss."""
+_WATCH = None
+
+
+def train_bench(cfg, batch: int, seq: int, steps: int, mu_dtype,
+                label: str = "train") -> dict:
+    """One sharded train-step benchmark; returns tok/s + MFU + loss.
+
+    The step is AOT-compiled so its cost_analysis() FLOPs are measured —
+    MFU is computed from what XLA actually schedules, with the hand
+    formula (train_flops_per_token) reported beside it as `mfu_formula`
+    and the ratio as `flops_measured_vs_formula`. The run is wrapped in a
+    phase watermark, so the reported HBM keys are scoped to THIS config."""
     from tony_tpu.models.llama import train_flops_per_token
+    from tony_tpu.obs.compiles import get_ledger
     from tony_tpu.obs.metrics import StepTimer, chip_peak_flops
     from tony_tpu.parallel.mesh import single_device_mesh
     from tony_tpu.train.trainer import default_optimizer, make_train_state, make_train_step
 
-    mesh = single_device_mesh()
-    opt = default_optimizer(warmup_steps=10, decay_steps=1000, mu_dtype=mu_dtype)
-    state = make_train_state(jax.random.key(0), cfg, mesh, opt)
-    step = make_train_step(cfg, mesh, opt)
-    tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size)
-    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    watch = _hbm_watch()
+    ledger = get_ledger()
+    with watch.phase(label) as ph:
+        mesh = single_device_mesh()
+        opt = default_optimizer(warmup_steps=10, decay_steps=1000, mu_dtype=mu_dtype)
+        state = make_train_state(jax.random.key(0), cfg, mesh, opt)
+        step = make_train_step(cfg, mesh, opt)
+        tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size)
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
 
-    state, metrics = step(state, inputs, targets)  # compile
-    state, metrics = step(state, inputs, targets)
-    float(metrics["loss"])
+        flops_per_step = 0.0
+        t0 = time.perf_counter()
+        try:
+            with ledger.label(label):
+                compiled = step.lower(state, inputs, targets).compile()
+            entry = ledger.record_aot(label, compiled, time.perf_counter() - t0)
+            flops_per_step = float(entry.get("flops", 0.0))
+            step = compiled  # ONE compile, analyses attached
+        except Exception:
+            pass  # lazy jit fallback: first call below compiles
 
-    timer = StepTimer(
-        flops_per_token=train_flops_per_token(cfg, seq),
-        tokens_per_step=batch * seq,
-        n_chips=1,
-    )
-    t0 = time.perf_counter()
-    for _ in range(steps):
+        state, metrics = step(state, inputs, targets)  # compile/warm
         state, metrics = step(state, inputs, targets)
-    final_loss = float(metrics["loss"])  # sync fence
-    timer.record(time.perf_counter() - t0, steps)
-    return {
+        float(metrics["loss"])
+
+        flops_formula = train_flops_per_token(cfg, seq)
+        flops_measured = flops_per_step / (batch * seq) if flops_per_step else 0.0
+        timer = StepTimer(
+            flops_per_token=flops_measured or flops_formula,
+            tokens_per_step=batch * seq,
+            n_chips=1,
+        )
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, inputs, targets)
+        final_loss = float(metrics["loss"])  # sync fence
+        timer.record(time.perf_counter() - t0, steps)
+    peak = chip_peak_flops()
+    out = {
         "tokens_per_sec_per_chip": round(timer.tokens_per_sec_per_chip, 1),
-        "mfu": round(timer.mfu(chip_peak_flops()), 4),
+        # headline MFU from measured FLOPs (cost_analysis) when available
+        "mfu": round(timer.mfu(peak), 4),
+        "mfu_formula": round(
+            timer.tokens_per_sec_per_chip * flops_formula / peak, 4
+        ),
+        "flops_source": "cost_analysis" if flops_measured else "formula",
         "loss": round(final_loss, 4),
         "batch": batch,
         "seq": seq,
         "steps": steps,
-        # per-config HBM high-water mark (the fused-CE win shows up here)
-        **_peak_hbm(),
+        # phase-scoped HBM watermark (the fused-CE win shows up here)
+        **ph.bench_keys(),
     }
+    if flops_measured:
+        out["flops_per_token_measured"] = round(flops_measured, 1)
+        out["flops_per_token_formula"] = round(flops_formula, 1)
+        out["flops_measured_vs_formula"] = round(
+            flops_measured / flops_formula, 4
+        )
+    return out
 
 
 def _timed_scan_grad(attn, q, *, reps: int, steps: int) -> dict:
@@ -274,14 +311,22 @@ def ce_head_bench(steps: int = 8) -> dict:
         except Exception as e:
             return {"error": f"{type(e).__name__}: {str(e)[:120]}"}
 
-    out = {
-        "dense": timed(lambda a, b: jnp.mean(reference_ce_tokens(a, b, t))),
-        "scan": timed(lambda a, b: jnp.mean(
+    out = {}
+    for name, lossf in (
+        ("dense", lambda a, b: jnp.mean(reference_ce_tokens(a, b, t))),
+        ("scan", lambda a, b: jnp.mean(
             fused_ce_tokens(a, b, t, impl="scan", vocab_chunk=4096))),
-        "pallas": timed(lambda a, b: jnp.mean(
+        ("pallas", lambda a, b: jnp.mean(
             fused_ce_tokens(a, b, t, impl="pallas"))),
-    }
-    out["peak_after"] = _peak_hbm()
+    ):
+        # phase-scoped watermark per impl: the dense head's logits+dlogits
+        # transient is attributed to the dense phase, not inherited by the
+        # fused ones (obs/hbm.py attribution rule)
+        with _hbm_watch().phase(f"ce_head_{name}") as ph:
+            out[name] = timed(lossf)
+        hk = ph.bench_keys()
+        if hk:
+            out[name]["hbm"] = hk
     return out
 
 
@@ -329,10 +374,9 @@ def moe_bench(steps: int = 10) -> dict:
     4 experts (~1.2B total / ~700M active): the 8-expert preset's AdamW
     state alone exceeds the chip's 16GB. Capacity factor 1.0 for the
     capacity paths (round-4 tuning, docs/PERF.md); irrelevant to grouped.
-    HBM: per-dispatch peaks are not reported — the device counter is a
-    cumulative process high-water mark (_peak_hbm) dominated by the earlier
-    dense bench; one labeled cumulative snapshot rides `cum_peak_after_moe`
-    instead."""
+    Each dispatch runs under its own phase watermark (obs/hbm.py), so the
+    per-dispatch HBM keys are scoped to that config — `peak_exact` says
+    whether the phase set a new process high-water mark."""
     from tony_tpu.models.llama import LlamaConfig
 
     def cfg_for(**kw):
@@ -351,15 +395,13 @@ def moe_bench(steps: int = 10) -> dict:
         try:
             r = train_bench(
                 cfg_for(**kw), batch=8, seq=2048, steps=steps,
-                mu_dtype=jnp.bfloat16,
+                mu_dtype=jnp.bfloat16, label=f"moe_{name}",
             )
-            # no per-dispatch peak keys: peak_bytes_in_use is a cumulative
-            # process high-water mark (_peak_hbm) already dominated by the
-            # earlier dense 1.35B bench, so attributing it to any one MoE
-            # config would be a lie — one labeled cumulative number below
             per_dispatch[name] = {
                 k: r[k]
-                for k in ("tokens_per_sec_per_chip", "mfu", "loss")
+                for k in ("tokens_per_sec_per_chip", "mfu", "loss",
+                          "phase_peak_hbm_gb", "phase_delta_peak_gb",
+                          "peak_exact")
                 if k in r
             }
         except Exception as e:
@@ -383,9 +425,6 @@ def moe_bench(steps: int = 10) -> dict:
         "seq": 2048,
         **(per_dispatch.get(headline_name, {}) if headline_name else {}),
         "per_dispatch": per_dispatch,
-        # process high-water mark AFTER all MoE configs ran — includes the
-        # earlier dense benches (cumulative, see _peak_hbm), hence the name
-        "cum_peak_after_moe": _peak_hbm(),
     }
     g = per_dispatch.get("grouped", {}).get("tokens_per_sec_per_chip", 0)
     b = per_dispatch.get("gather", {}).get("tokens_per_sec_per_chip", 0)
@@ -548,10 +587,15 @@ def gqa_capacity_demo() -> dict:
     """Max concurrent decode slots at bench_1b4 GQA shapes: the native
     n_kv_heads cache vs a repeat-expanded (n_heads-wide) one — the HBM
     headroom the native-GQA decode kernel buys, since the repeat layout
-    keeps every slot's K/V resident at n_heads width. Computed from the
-    chip's HBM budget (bytes_limit when a device reports one, the v5e 16GB
-    otherwise) minus resident params; the ratio is exactly the GQA factor."""
+    keeps every slot's K/V resident at n_heads width.
+
+    The budget is DERIVED from the decode step's compiled memory plan
+    (serve/capacity.py: params + fixed/per-slot temp + code from
+    ``memory_analysis()``, avals only — nothing allocated), replacing the
+    old ``hbm * 0.92 - params`` fragmentation guess; the formula numbers
+    ride along as ``*_formula`` so the delta stays visible in BENCH json."""
     from tony_tpu.models.llama import LlamaConfig
+    from tony_tpu.serve.capacity import derive_slot_budget
 
     import dataclasses
 
@@ -562,25 +606,42 @@ def gqa_capacity_demo() -> dict:
         hbm = int(stats.get("bytes_limit", 16 * 2**30))
     except Exception:
         hbm = 16 * 2**30
-    param_bytes = cfg.n_params * 2  # bf16 resident weights
-    budget = int(hbm * 0.92) - param_bytes  # ~8% runtime/fragmentation
+    # the superseded guess, kept visible so the measured delta is legible
+    param_bytes_formula = cfg.n_params * 2  # bf16 resident weights
+    budget_formula = int(hbm * 0.92) - param_bytes_formula
     per_slot_native = 2 * cfg.n_layers * max_len * cfg.n_kv_heads * cfg.head_dim * 2
     per_slot_repeat = 2 * cfg.n_layers * max_len * cfg.n_heads * cfg.head_dim * 2
-    native = max(0, budget // per_slot_native)
-    repeat = max(0, budget // per_slot_repeat)
-    return {
+    out = {
         "model": "bench_1b4_gqa16_4",
         "max_len": max_len,
         "hbm_gb": round(hbm / 2**30, 1),
-        "param_gb": round(param_bytes / 2**30, 2),
-        "kv_bytes_per_slot_native": per_slot_native,
-        "kv_bytes_per_slot_repeat": per_slot_repeat,
-        "max_slots_native": int(native),
-        "max_slots_repeat": int(repeat),
-        "native_vs_repeat": round(native / max(repeat, 1), 2),
-        "note": "budget-derived (HBM minus resident params); the ratio is "
-                "the GQA factor n_heads/n_kv_heads",
+        "max_slots_native_formula": max(0, budget_formula // per_slot_native),
+        "max_slots_repeat_formula": max(0, budget_formula // per_slot_repeat),
     }
+    try:
+        measured = derive_slot_budget(cfg, max_len=max_len, hbm_bytes=hbm)
+        out.update(measured)
+        out["param_gb"] = round(measured["param_bytes"] / 2**30, 2)
+        if measured["max_slots_native"]:
+            out["formula_vs_measured"] = round(
+                out["max_slots_native_formula"] / measured["max_slots_native"],
+                3,
+            )
+    except Exception as e:
+        # derivation unavailable (platform without memory_analysis): the
+        # formula numbers become the headline, labelled as such
+        out.update({
+            "source": "formula",
+            "error": f"{type(e).__name__}: {str(e)[:160]}",
+            "param_gb": round(param_bytes_formula / 2**30, 2),
+            "kv_bytes_per_slot_native": per_slot_native,
+            "kv_bytes_per_slot_repeat": per_slot_repeat,
+            "max_slots_native": out["max_slots_native_formula"],
+            "max_slots_repeat": out["max_slots_repeat_formula"],
+        })
+    native, repeat = out["max_slots_native"], out["max_slots_repeat"]
+    out["native_vs_repeat"] = round(native / max(repeat, 1), 2)
+    return out
 
 
 def pipeline_bench() -> dict:
@@ -713,27 +774,41 @@ def submit_latency_bench() -> dict:
     return out
 
 
+def _phased(name: str, fn) -> dict:
+    """Run one bench section under its own HBM phase watermark; the
+    section's dict gains an ``hbm`` key with the phase-scoped numbers
+    (absent on platforms without memory_stats). Errors become the
+    section's result, never the bench's."""
+    with _hbm_watch().phase(name) as ph:
+        try:
+            out = fn()
+        except Exception as e:
+            out = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    if isinstance(out, dict):
+        hk = ph.bench_keys()
+        if hk and "hbm" not in out:
+            out["hbm"] = hk
+    return out
+
+
 def run_bench() -> dict:
     from tony_tpu.models.llama import LlamaConfig
 
     on_tpu = jax.devices()[0].platform != "cpu"
     if not on_tpu:  # CPU fallback so the driver always gets a line
         cfg = LlamaConfig.tiny()
-        r = train_bench(cfg, batch=4, seq=64, steps=3, mu_dtype=jnp.float32)
+        r = train_bench(cfg, batch=4, seq=64, steps=3, mu_dtype=jnp.float32,
+                        label="tiny_cpu")
         extra = {"device": jax.devices()[0].device_kind, **r}
-        try:
-            # batch 8: fit()'s default mesh shards batch over every local
-            # device (8 virtual CPU devices under the test rig)
-            extra["overlap_fit"] = overlap_bench(
-                cfg, batch=8, seq=64, steps=6, mu_dtype="float32"
-            )
-        except Exception as e:
-            extra["overlap_fit"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
-        try:
-            extra["decode"] = decode_bench(on_tpu=False)
-        except Exception as e:
-            extra["decode"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
-        extra["gqa_capacity"] = gqa_capacity_demo()
+        # batch 8: fit()'s default mesh shards batch over every local
+        # device (8 virtual CPU devices under the test rig)
+        extra["overlap_fit"] = _phased("overlap_fit", lambda: overlap_bench(
+            cfg, batch=8, seq=64, steps=6, mu_dtype="float32"
+        ))
+        extra["decode"] = _phased(
+            "decode", lambda: decode_bench(on_tpu=False)
+        )
+        extra["gqa_capacity"] = _phased("gqa_capacity", gqa_capacity_demo)
         return {
             "metric": "llama_tiny_cpu_tokens_per_sec",
             "value": r["tokens_per_sec_per_chip"],
@@ -748,12 +823,14 @@ def run_bench() -> dict:
         # transient that made batch 8 OOM at round 3 (docs/PERF.md)
     )
     try:
-        main = train_bench(cfg, batch=8, seq=2048, steps=10, mu_dtype=jnp.bfloat16)
+        main = train_bench(cfg, batch=8, seq=2048, steps=10,
+                           mu_dtype=jnp.bfloat16, label="dense_1b4_b8")
         batch_note = "batch 8 (fused CE freed the loss-head transient)"
     except Exception as e:
         # never lose the headline metric to an OOM regression: fall back to
         # the round-3 batch and record why
-        main = train_bench(cfg, batch=4, seq=2048, steps=10, mu_dtype=jnp.bfloat16)
+        main = train_bench(cfg, batch=4, seq=2048, steps=10,
+                           mu_dtype=jnp.bfloat16, label="dense_1b4_b4")
         batch_note = f"batch 8 failed ({type(e).__name__}: {str(e)[:120]}); ran batch 4"
 
     extra = {
@@ -778,51 +855,38 @@ def run_bench() -> dict:
         extra["fused_ce_matches_dense_on_tpu"] = fused_ce_matches_dense_on_tpu()
     except Exception as e:
         extra["fused_ce_matches_dense_on_tpu"] = f"{type(e).__name__}: {str(e)[:120]}"
-    try:
-        extra["ce_head_b8"] = ce_head_bench()
-    except Exception as e:
-        extra["ce_head_b8"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
-    extra["attn_kernel_s8192"] = kernel_bench_s8192()
-    extra["gqa_kernel_32_8"] = gqa_kernel_bench()
-    extra["flash_s32768"] = long_context_bench()
-    try:
-        extra["moe_top2"] = moe_bench()
-    except Exception as e:
-        extra["moe_top2"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
-    try:
+    # every section under its own phase watermark: the HBM numbers in each
+    # section are scoped to it, never inherited from an earlier one
+    extra["ce_head_b8"] = _phased("ce_head_b8", ce_head_bench)
+    extra["attn_kernel_s8192"] = _phased("attn_kernel_s8192", kernel_bench_s8192)
+    extra["gqa_kernel_32_8"] = _phased("gqa_kernel_32_8", gqa_kernel_bench)
+    extra["flash_s32768"] = _phased("flash_s32768", long_context_bench)
+    extra["moe_top2"] = _phased("moe_top2", moe_bench)
+
+    def _overlap():
         # same 1.35B config through the REAL input pipeline, prefetch off/on;
         # lifts the stall metric + startup phases to top-level extra keys so
         # the BENCH trajectory tracks them
         # reuse whatever batch the headline run proved fits (8, or the
         # batch-4 fallback) so an OOM can't erase the stall metrics
-        overlap = overlap_bench(
+        return overlap_bench(
             cfg, batch=main["batch"], seq=2048, steps=10, mu_dtype="bfloat16"
         )
-        extra["overlap_fit"] = overlap
-        p2 = overlap.get("prefetch2", {})
-        if "host_blocked_ms_per_step" in p2:
-            extra["host_blocked_ms_per_step"] = p2["host_blocked_ms_per_step"]
-        if "startup" in p2:
-            extra["startup_phases"] = p2["startup"]
-    except Exception as e:
-        extra["overlap_fit"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
-    try:
-        # serving: continuous batching vs sequential batch-1 + TTFT + slot
-        # occupancy (the decode counterpart of the training headline)
-        extra["decode"] = decode_bench(on_tpu=True)
-    except Exception as e:
-        extra["decode"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
-    extra["gqa_capacity"] = gqa_capacity_demo()
-    try:
-        extra["pipeline"] = pipeline_bench()
-    except Exception as e:
-        extra["pipeline"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
-    try:
-        extra["submit_to_first_step_s"] = submit_latency_bench()
-    except Exception as e:
-        extra["submit_to_first_step_s"] = {
-            "error": f"{type(e).__name__}: {str(e)[:160]}"
-        }
+
+    overlap = extra["overlap_fit"] = _phased("overlap_fit", _overlap)
+    p2 = overlap.get("prefetch2", {})
+    if "host_blocked_ms_per_step" in p2:
+        extra["host_blocked_ms_per_step"] = p2["host_blocked_ms_per_step"]
+    if "startup" in p2:
+        extra["startup_phases"] = p2["startup"]
+    # serving: continuous batching vs sequential batch-1 + TTFT + slot
+    # occupancy (the decode counterpart of the training headline)
+    extra["decode"] = _phased("decode", lambda: decode_bench(on_tpu=True))
+    extra["gqa_capacity"] = _phased("gqa_capacity", gqa_capacity_demo)
+    extra["pipeline"] = _phased("pipeline", pipeline_bench)
+    extra["submit_to_first_step_s"] = _phased(
+        "submit_to_first_step_s", submit_latency_bench
+    )
 
     return {
         "metric": "llama1.4b_train_tokens_per_sec_per_chip",

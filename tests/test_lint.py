@@ -364,6 +364,22 @@ class TestGL005:
         """}, select="GL005")
         assert fs == []
 
+    def test_hbm_sample_seam_holds_the_same_contract(self, tmp_path):
+        """The HBM observatory's sample() seam (obs/hbm.py) is a hook site
+        like trace.span: expensive arguments fire, bare calls are clean."""
+        fs = lint_src(tmp_path, {"mod.py": """
+            from tony_tpu.obs import hbm
+
+            def hot_loop(step):
+                hbm.sample()                    # the wired call shape: clean
+                hbm.sample(note=describe(step))  # eager call arg: fires
+
+            def describe(step):
+                return {"step": step}
+        """}, select="GL005")
+        assert len(fs) == 1
+        assert "disarmed" in fs[0].message and fs[0].line == 6
+
 
 # --- suppression / baseline machinery ----------------------------------------
 

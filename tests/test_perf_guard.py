@@ -207,14 +207,23 @@ def test_disarmed_trace_span_is_within_noise_of_noop():
 
 def test_fit_loop_stays_unblocked_with_tracing_armed(tmp_path):
     """The armed contract: with the trace spine recording at the default
-    sampling stride, the tiny-model fit loop must still clear the
-    host-blocked overlap budget — tracing is always-on in jobs, so its
-    cost rides inside the same tier-1 guard as the data path."""
-    from tony_tpu.obs import trace
+    sampling stride AND the HBM observatory sampling at its default
+    stride, the tiny-model fit loop must still clear the host-blocked
+    overlap budget — both hooks are always-on in jobs, so their cost
+    rides inside the same tier-1 guard as the data path."""
+    from tony_tpu.obs import hbm, trace
 
     tracer = trace.install(trace.Tracer(
         str(tmp_path / "trace" / "guard.jsonl"), "guard", "guardtrace",
         sample_steps=16,  # the trace.sample_steps default
+    ))
+    # a stats fake so the CPU rig exercises the full armed path (real
+    # reading + gauge + counter-track emission) at the default stride
+    hbm.install(hbm.HbmWatch(
+        stats_fn=lambda: [("dev0", {
+            "bytes_in_use": 1 << 30, "peak_bytes_in_use": 2 << 30,
+        })],
+        sample_every=16,  # the obs.hbm.sample_steps default
     ))
     try:
         final = fit(FitConfig(
@@ -228,10 +237,11 @@ def test_fit_loop_stays_unblocked_with_tracing_armed(tmp_path):
         ))
     finally:
         trace.uninstall()
+        hbm.uninstall()
     assert np.isfinite(final["final_loss"])
     assert final["host_blocked_frac"] < MAX_HOST_BLOCKED_FRAC, (
         f"step loop is {final['host_blocked_frac']:.0%} host-blocked with "
-        "tracing armed — the spine is stalling the loop"
+        "tracing + memory sampling armed — a spine is stalling the loop"
     )
     # the spine actually recorded: fit root + sampled step spans, and the
     # step-time distribution made it into the final report
@@ -244,3 +254,47 @@ def test_fit_loop_stays_unblocked_with_tracing_armed(tmp_path):
     steps = [r for r in recs if r.get("name") == "train.step"]
     assert all(r["args"]["every"] == 16 for r in steps)
     assert final["step_time_p99_s"] >= final["step_time_p50_s"] > 0
+    # the memory observatory recorded too: per-device counter-track rows
+    # in the same journal (the `tony trace` memory timeline)
+    counters = [r for r in recs if r.get("ph") == "C"]
+    assert counters and counters[0]["name"] == "hbm.dev0"
+    assert counters[0]["args"]["live_gb"] == 1.0
+
+
+def test_disarmed_hbm_sample_is_within_noise_of_noop():
+    """The HBM observatory's no-op contract (the trace-span twin): a
+    sample() call with no watch armed is one global load + None compare —
+    cheap enough to sit in the train/serve step loops unconditionally.
+    graft-lint GL005 holds the call-site side of the same contract."""
+    import time
+
+    from tony_tpu.obs import hbm
+
+    hbm.uninstall()  # other tests/fit runs may have armed the process
+    N = 50_000
+    for _ in range(1000):
+        hbm.sample()
+    per_call = math.inf
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(N):
+            hbm.sample()
+        per_call = min(per_call, (time.perf_counter() - t0) / N)
+    assert per_call < 5e-6, (
+        f"disarmed hbm.sample costs {per_call * 1e9:.0f}ns/call — the "
+        "no-op path regressed (is something arming a watch or allocating?)"
+    )
+    # and the armed-but-off-stride path is one counter bump, no reading
+    calls = []
+    watch = hbm.install(hbm.HbmWatch(
+        stats_fn=lambda: calls.append(1) or [], sample_every=1000,
+    ))
+    try:
+        for _ in range(999):
+            hbm.sample()
+        assert calls == []  # stats never read off-stride
+        hbm.sample()
+        assert len(calls) == 1
+        assert watch is hbm.active_watch()
+    finally:
+        hbm.uninstall()

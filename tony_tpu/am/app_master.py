@@ -27,7 +27,7 @@ from typing import Any
 
 from tony_tpu.am.events import EventType, EventWriter
 from tony_tpu.chaos import chaos_hook
-from tony_tpu.obs import trace
+from tony_tpu.obs import hbm, trace
 from tony_tpu.am.scheduler import SchedulerHooks, TaskScheduler
 from tony_tpu.am.session import JobState, Session, TaskState, TERMINAL
 from tony_tpu.cluster import make_backend
@@ -135,6 +135,17 @@ class ApplicationMaster(ApplicationRpcServicer):
             env[trace.ENV_JOURNAL_MB] = str(tracer.max_journal_mb)
             env[trace.ENV_PROC] = f"{spec.name}_{index}_exec_a{attempt}"
             env[trace.ENV_PARENT] = self._run_span.sid
+        # HBM-observatory contract (obs/hbm.py): the device-owning user
+        # process arms itself from these; the AM holds no device
+        env[hbm.ENV_ENABLED] = (
+            "1" if self.config.get_bool(Keys.OBS_HBM_ENABLED, True) else "0"
+        )
+        env[hbm.ENV_SAMPLE] = str(
+            self.config.get_int(Keys.OBS_HBM_SAMPLE_STEPS, 16)
+        )
+        env[hbm.ENV_HISTORY] = str(
+            self.config.get_int(Keys.OBS_HBM_HISTORY, 512)
+        )
         log_path = os.path.join(
             self.app_dir, "logs", f"{spec.name}_{index}_attempt{attempt}.log"
         )

@@ -18,7 +18,9 @@ way tsan complements a lock-discipline lint:
   the guarded region. Steady state means ZERO new compiles: a recompile
   per step is the classic silent 100x (GL002's dynamic shadow). Budget
   overruns raise :class:`SanitizeError` at the first excess compile, with
-  the count in the message.
+  the count in the message. The counter is the compile LEDGER's
+  (obs/compiles.py — one listener serves the watchdog and the always-on
+  compile journal ``tony compiles`` reads).
 
 Wired into ``fit()`` (steady state: after the first step resolved) and
 ``Engine.run()`` under ``GRAFT_SANITIZE=1``; both are no-ops otherwise.
@@ -31,14 +33,9 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 
 ENV_FLAG = "GRAFT_SANITIZE"
 ENV_MAX_COMPILES = "GRAFT_SANITIZE_MAX_COMPILES"
-
-_counter_lock = threading.Lock()
-_compile_events = 0
-_listener_installed = False
 
 
 class SanitizeError(RuntimeError):
@@ -56,32 +53,13 @@ def _max_compiles(default: int = 0) -> int:
         return default
 
 
-def _on_duration_event(event: str, duration: float, **_kw) -> None:
-    global _compile_events
-    if event == "/jax/core/compile/backend_compile_duration":
-        with _counter_lock:
-            _compile_events += 1
-
-
-def _ensure_listener() -> None:
-    """Install the (permanent, cheap) monitoring listener once per process.
-    jax.monitoring has no per-listener removal, so the counter always runs
-    and watchdogs compare snapshots of it."""
-    global _listener_installed
-    with _counter_lock:
-        if _listener_installed:
-            return
-        _listener_installed = True
-    import jax.monitoring
-
-    jax.monitoring.register_event_duration_secs_listener(_on_duration_event)
-
-
 def compile_count() -> int:
-    """Process-wide backend-compile count since the listener was armed."""
-    _ensure_listener()
-    with _counter_lock:
-        return _compile_events
+    """Process-wide backend-compile count since the listener was armed —
+    the compile ledger's counter (obs/compiles.py), so the watchdog and
+    the compile journal can never disagree on what compiled."""
+    from tony_tpu.obs.compiles import get_ledger
+
+    return get_ledger().backend_compiles
 
 
 class CompileWatchdog:

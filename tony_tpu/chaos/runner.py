@@ -32,6 +32,10 @@ class ChaosRunResult:
     exit_code: int   # the job's client exit code (faults may legitimately fail the job)
     state: str       # final state from status.json ("" if never written)
     report: InvariantReport
+    # OOM forensics bundles any process dumped under <app_dir>/oom/ —
+    # a RESOURCE_EXHAUSTED death during the run is a finding the
+    # post-mortem must surface, not a silent exit code (obs/hbm.py)
+    oom_forensics: list[str] | None = None
 
     def to_dict(self) -> dict:
         return {
@@ -40,6 +44,7 @@ class ChaosRunResult:
             "exit_code": self.exit_code,
             "state": self.state,
             "report": self.report.to_dict(),
+            "oom_forensics": self.oom_forensics or [],
         }
 
 
@@ -65,12 +70,15 @@ def run_chaos_job(config: TonyConfig, src_dir: str = "", quiet: bool = True) -> 
     report = check_invariants(
         [client.app_dir], rm_root=config.get_str(Keys.CLUSTER_RM_ROOT, "")
     )
+    from tony_tpu.obs.hbm import forensics_files
+
     return ChaosRunResult(
         app_id=client.app_id,
         app_dir=client.app_dir,
         exit_code=code,
         state=state,
         report=report,
+        oom_forensics=forensics_files(client.app_dir),
     )
 
 

@@ -216,7 +216,7 @@ def cmd_trace(args: argparse.Namespace) -> int:
     # processes, instants) — a job whose every process died early still
     # has exactly the flight-recorder data worth merging
     n_events = sum(
-        1 for e in merged["traceEvents"] if e.get("ph") in ("X", "B", "i")
+        1 for e in merged["traceEvents"] if e.get("ph") in ("X", "B", "i", "C")
     )
     if n_events == 0:
         print(
@@ -232,6 +232,27 @@ def cmd_trace(args: argparse.Namespace) -> int:
     summary["out"] = out_path
     summary["events"] = len(merged["traceEvents"])
     print(json.dumps(summary, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_compiles(args: argparse.Namespace) -> int:
+    """Report an application's compile ledgers (obs/compiles.py): per
+    process, every XLA backend compile with its duration and attributed
+    fn name, plus the AOT entry points' measured memory plans
+    (memory_analysis temp/argument/output/code bytes) and cost-analysis
+    FLOPs — the 'what compiled, when, and what it costs in HBM' answer."""
+    from tony_tpu.obs.compiles import read_app_ledgers, summarize
+
+    app_dir = resolve_app_dir(args.app)
+    ledgers = read_app_ledgers(app_dir)
+    if not ledgers:
+        print(
+            f"no compile ledgers under {os.path.join(app_dir, 'compiles')} "
+            "(job predates the ledger, or no JAX process ran fit()/serve)",
+            file=sys.stderr,
+        )
+        return 1
+    print(json.dumps(summarize(ledgers), indent=2, sort_keys=True))
     return 0
 
 
@@ -355,6 +376,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="output path (default <app_dir>/trace.json)",
     )
     s.set_defaults(fn=cmd_trace)
+
+    s = sub.add_parser(
+        "compiles",
+        help="report an app's compile ledgers (per-process XLA compiles, "
+             "AOT memory plans and FLOPs)",
+    )
+    s.add_argument("app", help="application id or app-dir path")
+    s.set_defaults(fn=cmd_compiles)
 
     s = sub.add_parser(
         "lint",

@@ -72,6 +72,15 @@ class Keys:
     # per-process journal rotation size: at the cap the journal rotates and
     # the newest window is kept (flight-recorder retention, <= 2x on disk)
     TRACE_MAX_JOURNAL_MB = "trace.max_journal_mb"
+    # HBM observatory (obs/hbm.py; docs/OBS.md "Memory and compiles"):
+    # phase-scoped device-memory watermarks, sampled per-step readings as
+    # Perfetto counter tracks, and OOM forensics dumps
+    OBS_HBM_ENABLED = "obs.hbm.enabled"
+    # read device memory_stats every Nth train/serve step (the counter-
+    # track sampling stride; off-stride calls are one increment + compare)
+    OBS_HBM_SAMPLE_STEPS = "obs.hbm.sample_steps"
+    # per-process in-memory sample-history ring (lands in OOM forensics)
+    OBS_HBM_HISTORY = "obs.hbm.history_events"
 
     # --- cluster backend ---
     # Deliberate non-goals vs the reference key surface: docker keys (no
@@ -182,6 +191,9 @@ DEFAULTS: dict[str, object] = {
     Keys.TRACE_SAMPLE_STEPS: 16,
     Keys.TRACE_RING_EVENTS: 4096,
     Keys.TRACE_MAX_JOURNAL_MB: 64,
+    Keys.OBS_HBM_ENABLED: True,
+    Keys.OBS_HBM_SAMPLE_STEPS: 16,
+    Keys.OBS_HBM_HISTORY: 512,
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",

@@ -216,6 +216,15 @@ class Tracer:
             "tid": threading.get_native_id(), "args": args,
         })
 
+    def counter(self, name: str, **values: float) -> None:
+        """A counter-track sample (Chrome ``ph: "C"``): each numeric kwarg
+        becomes one series on the ``name`` track in the merged timeline
+        (the HBM observatory emits per-device live/peak memory this way,
+        obs/hbm.py)."""
+        self._enqueue({
+            "ph": "C", "name": name, "ts": self._now_us(), "args": values,
+        })
+
     def _finish(self, span: Span) -> None:
         end = time.perf_counter()
         self._enqueue({

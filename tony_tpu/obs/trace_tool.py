@@ -30,7 +30,8 @@ from tony_tpu.am.events import EventType, read_history
 
 def load_journals(trace_dir: str) -> list[dict[str, Any]]:
     """Read every per-process journal: returns one entry per process,
-    ``{"proc", "pid", "trace", "dropped", "spans": [...], "instants": [...]}``.
+    ``{"proc", "pid", "trace", "dropped", "spans": [...], "instants": [...],
+    "counters": [...]}``.
     Torn trailing lines (a SIGKILLed writer) are skipped, not fatal; a
     rotated window (``<proc>.0.jsonl``, written when the journal hits its
     size cap) merges into the same process entry."""
@@ -44,6 +45,7 @@ def load_journals(trace_dir: str) -> list[dict[str, Any]]:
         entry: dict[str, Any] = {
             "proc": name[:-len(".jsonl")], "pid": 0, "trace": "",
             "dropped": 0, "spans": [], "instants": [], "opens": [],
+            "counters": [],
         }
         try:
             with open(os.path.join(trace_dir, name), encoding="utf-8") as f:
@@ -69,6 +71,9 @@ def load_journals(trace_dir: str) -> list[dict[str, Any]]:
                         # begin-only: a span open when a chaos SIGKILL hit
                         # (emergency_flush journals these pre-kill)
                         entry["opens"].append(rec)
+                    elif ph == "C":
+                        # counter-track sample (per-device HBM, obs/hbm.py)
+                        entry["counters"].append(rec)
         except OSError:
             continue
         prev = by_proc.get(entry["proc"])
@@ -79,6 +84,7 @@ def load_journals(trace_dir: str) -> list[dict[str, Any]]:
             prev["spans"].extend(entry["spans"])
             prev["instants"].extend(entry["instants"])
             prev["opens"].extend(entry["opens"])
+            prev["counters"].extend(entry["counters"])
             prev["dropped"] += entry["dropped"]
             prev["pid"] = prev["pid"] or entry["pid"]
             prev["trace"] = prev["trace"] or entry["trace"]
@@ -133,6 +139,14 @@ def merge_chrome(app_dir: str,
                 "ts": o.get("ts", 0), "pid": i, "tid": o.get("tid", 0),
                 "args": {**o.get("args", {}), "killed": True,
                          "sid": o.get("sid", ""), "psid": o.get("psid", "")},
+            })
+        for c in p["counters"]:
+            # counter track (per-device HBM live/peak): each numeric arg
+            # renders as one series on the process's memory timeline
+            events.append({
+                "ph": "C", "name": c.get("name", "?"), "cat": "tony",
+                "ts": c.get("ts", 0), "pid": i, "tid": 0,
+                "args": c.get("args", {}),
             })
     events.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
@@ -282,7 +296,7 @@ def report(app_dir: str,
         "processes": [
             {"proc": p["proc"], "spans": len(p["spans"]),
              "instants": len(p["instants"]), "open_at_kill": len(p["opens"]),
-             "dropped": p["dropped"]}
+             "counters": len(p["counters"]), "dropped": p["dropped"]}
             for p in procs
         ],
         "goodput": goodput(app_dir, procs),

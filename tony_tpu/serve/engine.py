@@ -48,7 +48,8 @@ import numpy as np
 from jax import lax
 
 from tony_tpu.models.llama import LlamaConfig, Params, rms_norm, rope_freqs
-from tony_tpu.obs import trace
+from tony_tpu.obs import hbm, trace
+from tony_tpu.obs import compiles as compile_ledger
 from tony_tpu.obs.metrics import DecodeMetrics
 from tony_tpu.obs.registry import Registry, snapshot_to_app_dir
 from tony_tpu.ops.decode_attention import decode_attention
@@ -222,6 +223,17 @@ class Engine:
         # (restart, bench sweep) reports its own distributions, not a
         # blend with its predecessor's
         trace.install_from_env()
+        # HBM observatory + compile ledger: sampled memory counter tracks
+        # from the decode loop, AOT decode compiles journaled with their
+        # measured memory plans (obs/hbm.py, obs/compiles.py)
+        hbm.install_from_env()
+        self._ledger = compile_ledger.get_ledger()
+        self._compiles_t0 = self._ledger.backend_compiles
+        # engine-scoped watermark mark: close() reports THIS engine's peak
+        # via the attribution rule, never the process's cumulative counter
+        # (a train-then-serve process must not inherit the trainer's peak)
+        watch = hbm.active_watch()
+        self._hbm_mark = watch.mark() if watch is not None else None
         self._init_registry()
         self._queued_spans: dict[int, Any] = {}
         self._decode_spans: dict[int, Any] = {}
@@ -316,12 +328,27 @@ class Engine:
             s["ttft_p99_s"] = round(self._h_ttft.quantile(0.99), 4)
         if self._h_tpot.count:
             s["tpot_p50_s"] = round(self._h_tpot.quantile(0.5), 4)
+        # ledger-sourced lines: XLA compiles this engine actually triggered
+        # (the DecodeMetrics counts are per-signature intents; this is what
+        # the backend really compiled) and the engine-scoped peak-HBM
+        # watermark (marked at __init__, measured by the attribution rule)
+        s["xla_compiles"] = self._ledger.backend_compiles - self._compiles_t0
+        watch = hbm.active_watch()
+        if watch is not None and self._hbm_mark is not None:
+            peak_gb, peak_exact = watch.peak_since(self._hbm_mark)
+            if peak_gb:
+                s["peak_hbm_gb"] = peak_gb
+                s["peak_hbm_exact"] = peak_exact
+            # gauges into THIS registry so tony_hbm_* lands in the
+            # job-history snapshot the portal /metrics serves
+            watch.export_gauges(self.registry)
         log.info("engine shutdown: %s", s)
         # suffixed so a train-then-serve user process cannot overwrite one
         # component's snapshot with the other's
         snapshot_to_app_dir(
             trace.default_proc_name("serve") + "_engine", self.registry
         )
+        compile_ledger.snapshot_to_app_dir()
         return s
 
     def step(self) -> int:
@@ -348,10 +375,13 @@ class Engine:
         (GRAFT_SANITIZE=1): implicit D2H transfers and steady-state
         compiles raise (analysis/sanitize.py). A cold engine compiles per
         prefill bucket / cache capacity by design — sanitize a *warmed*
-        engine, or budget via GRAFT_SANITIZE_MAX_COMPILES."""
+        engine, or budget via GRAFT_SANITIZE_MAX_COMPILES. A
+        RESOURCE_EXHAUSTED escaping the loop dumps OOM forensics into the
+        app dir before re-raising (obs/hbm.py)."""
         from tony_tpu.analysis import sanitize
 
-        with sanitize.sanitized_loop("decode") as watchdog:
+        with hbm.oom_guard("engine.run"), \
+                sanitize.sanitized_loop("decode") as watchdog:
             while self._queue or self.n_live:
                 self.step()
                 if watchdog is not None:
@@ -386,11 +416,14 @@ class Engine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :plen] = prompt
             key = _as_raw_key(req.rng, rid)
-            tok, carry, pk, pv = self._get_prefill(bucket)(
-                self.params, jnp.asarray(padded), jnp.int32(plen - 1),
-                jnp.float32(req.temperature), jnp.int32(req.top_k),
-                jnp.float32(req.top_p), key,
-            )
+            # ledger attribution: a fresh bucket compile fired inside this
+            # call journals under the prefill's name, not anonymously
+            with self._ledger.label(f"serve.prefill[{bucket}]"):
+                tok, carry, pk, pv = self._get_prefill(bucket)(
+                    self.params, jnp.asarray(padded), jnp.int32(plen - 1),
+                    jnp.float32(req.temperature), jnp.int32(req.top_k),
+                    jnp.float32(req.top_p), key,
+                )
             # EXPLICIT sync: the sampled first token steers admission on
             # the host (transfer-guard-clean under GRAFT_SANITIZE)
             tok = int(jax.device_get(tok))
@@ -491,14 +524,18 @@ class Engine:
 
     def _get_decode(self, capacity: int):
         if capacity not in self._decode_fns:
-            # ONE jitted wrapper per (model, kernel) config, shared across
-            # engines module-wide (jit caches per argument shape, so every
+            # AOT-compiled per (model, kernel, shapes, sharding), shared
+            # across engines module-wide (_aot_decode's cache — every
             # capacity/slot-count signature compiles once per process, not
-            # once per Engine); the per-engine dict only counts the
-            # distinct capacities this engine entered
-            self._decode_fns[capacity] = _decode_fn(
+            # once per Engine); the AOT executable is what lets the ledger
+            # record the decode step's measured memory plan
+            # (memory_analysis: params + temp + per-slot KV bytes), which
+            # the gqa_capacity slot budget is derived from. The per-engine
+            # dict only counts the distinct capacities this engine entered.
+            self._decode_fns[capacity] = _aot_decode(
                 self.cfg, self.serve.decode_impl, self.serve.kv_block,
-                self.serve.max_top_k,
+                self.serve.max_top_k, self.params, self.cache, self.state,
+                self._ledger,
             )
             self.metrics.decode_compiles = len(self._decode_fns)
         return self._decode_fns[capacity]
@@ -526,6 +563,7 @@ class Engine:
         self.metrics.record_decode(
             dt, len(live_before), len(live_before), self.serve.slots
         )
+        hbm.sample()  # stride-counted device-memory reading (no sync)
         self._h_step.observe(dt)
         self._c_tokens.inc(len(live_before))
         for s in live_before:
@@ -569,6 +607,44 @@ def _decode_fn(cfg: LlamaConfig, decode_impl: str, kv_block: int,
         ),
         donate_argnums=(1, 2),
     )
+
+
+# AOT decode executables shared module-wide: keyed by model/kernel knobs +
+# the cache/state shapes + the params' sharding, so engines with the same
+# model reuse every compiled signature (the lru_cache-on-jit property the
+# lazy path had), while the AOT form exposes memory_analysis()/
+# cost_analysis() to the compile ledger and serve/capacity.py
+_aot_decode_cache: dict = {}
+
+
+def _aot_decode(cfg: LlamaConfig, decode_impl: str, kv_block: int,
+                max_top_k: int, params, cache, state, ledger):
+    fn = _decode_fn(cfg, decode_impl, kv_block, max_top_k)
+    try:
+        shard = jax.tree.leaves(params)[0].sharding
+        key = (cfg, decode_impl, kv_block, max_top_k,
+               cache.k.shape, str(cache.k.dtype), hash(shard), shard)
+    except Exception:
+        # unhashable sharding (exotic platform): lazy jit still works and
+        # still shares compiles process-wide
+        return fn
+    hit = _aot_decode_cache.get(key)
+    if hit is not None:
+        return hit
+    t0 = time.perf_counter()
+    capacity = cache.k.shape[3]
+    name = f"serve.decode[slots={cache.k.shape[1]},cap={capacity}]"
+    try:
+        with ledger.label(name):
+            compiled = fn.lower(params, cache, state).compile()
+        ledger.record_aot(name, compiled, time.perf_counter() - t0)
+    except Exception:
+        log.debug("AOT decode compile failed; using lazy jit dispatch",
+                  exc_info=True)
+        compiled = fn
+    if len(_aot_decode_cache) < 512:
+        _aot_decode_cache[key] = compiled
+    return compiled
 
 
 @functools.lru_cache(maxsize=1)

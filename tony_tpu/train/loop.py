@@ -41,7 +41,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from tony_tpu.models.llama import LlamaConfig, train_flops_per_token
-from tony_tpu.obs import trace
+from tony_tpu.obs import hbm, trace
+from tony_tpu.obs import compiles as compile_ledger
 from tony_tpu.obs.metrics import StepTimer, chip_peak_flops
 from tony_tpu.obs.registry import Registry, snapshot_to_app_dir
 from tony_tpu.parallel.mesh import MeshShape, build_mesh
@@ -122,8 +123,14 @@ def fit(cfg: FitConfig) -> dict:
     # root handle rides into _fit because the compile-ahead worker thread
     # has an empty span stack and must parent on it explicitly
     trace.install_from_env()
+    # arm the HBM observatory (idempotent; TONY_OBS_HBM=0 disables) and the
+    # OOM guard: a RESOURCE_EXHAUSTED escaping the loop dumps the device
+    # memory profile + compile ledger + watermark history into the app dir
+    # before re-raising (obs/hbm.py, docs/OBS.md "Memory and compiles")
+    hbm.install_from_env()
     with diagnostics_context(), trace.span("train.fit", steps=cfg.steps) as root:
-        return _fit(cfg, root)
+        with hbm.oom_guard("fit"):
+            return _fit(cfg, root)
 
 
 def _start_async_host_copy(metrics: dict) -> None:
@@ -140,6 +147,17 @@ def _start_async_host_copy(metrics: dict) -> None:
 
 def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     jax_tpu.initialize()  # no-op outside a tony-tpu job
+    # always-on compile journal (obs/compiles.py): every XLA backend
+    # compile during this run is an entry; the shutdown summary and
+    # `tony compiles <app_id>` report from it
+    ledger = compile_ledger.get_ledger()
+    compiles_t0 = ledger.backend_compiles
+    hbm_watch = hbm.active_watch()
+    # run-scoped watermark mark: the shutdown summary reports THIS run's
+    # peak via the attribution rule (hbm.measure_since), not the process's
+    # cumulative counter — a second fit() in the same process (bench
+    # sweeps) must not inherit the first one's peak
+    hbm_mark = hbm_watch.mark() if hbm_watch is not None else None
     cfg.apply_job_env()
     if cfg.ce_impl or cfg.moe_dispatch or cfg.moe_group_block:
         from dataclasses import replace as _replace
@@ -220,15 +238,20 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             # on train.fit explicitly or this lands beside it, not inside
             with trace.span("fit.startup.compile", parent=fit_span.sid or None):
                 try:
-                    aot["step"] = step_fn.lower(
-                        state_avals, batch_aval, batch_aval
-                    ).compile()
+                    with ledger.label("train.step"):
+                        aot["step"] = step_fn.lower(
+                            state_avals, batch_aval, batch_aval
+                        ).compile()
                 except Exception:
                     log.debug(
                         "compile-ahead failed; jit dispatch compiles lazily",
                         exc_info=True,
                     )
             startup["compile_s"] = round(time.perf_counter() - t0, 3)
+            if "step" in aot:
+                # AOT entry point: journal the measured memory plan
+                # (temp/arg/output/code bytes) + cost-analysis FLOPs
+                ledger.record_aot("train.step", aot["step"], startup["compile_s"])
 
         compile_thread = threading.Thread(
             target=_compile_ahead, name="tony-compile-ahead", daemon=True
@@ -405,6 +428,7 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
                 h_step.observe(time.perf_counter() - t_sync)
             else:
                 state, metrics = _dispatch(state, inputs, targets)
+            hbm.sample()  # stride-counted device-memory reading (no sync)
             window += 1
             if pending is not None:
                 _emit(pending)  # previous boundary, now that N+1 is in flight
@@ -475,8 +499,20 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             final["metrics_dropped"] = reporter.dropped
     # registry snapshot into the job history (no-op outside a tony job);
     # suffixed so a train-then-serve user process cannot overwrite one
-    # component's snapshot with the other's
+    # component's snapshot with the other's. The HBM gauges export into
+    # THIS registry first, so tony_hbm_* reaches the portal /metrics.
+    if hbm_watch is not None:
+        hbm_watch.export_gauges(registry)
     snapshot_to_app_dir(trace.default_proc_name("train") + "_fit", registry)
+    # compile-ledger snapshot for `tony compiles <app_id>` (process-scoped,
+    # so the bare proc name; no-op outside a tony job) + summary lines
+    compile_ledger.snapshot_to_app_dir()
+    final["xla_compiles"] = ledger.backend_compiles - compiles_t0
+    if hbm_mark is not None:
+        peak_gb, peak_exact = hbm_watch.peak_since(hbm_mark)
+        if peak_gb:
+            final["peak_hbm_gb"] = peak_gb
+            final["peak_hbm_exact"] = peak_exact
     # steady-state input-stall + throughput accounting (first step excluded:
     # it absorbs warmup). The last boundary _emit synced the final step, so
     # the wall-clock window below covers completed work only.
@@ -497,10 +533,11 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
         # worker log, not only behind the portal
         log.info(
             "fit summary: steps=%d loss=%.4f step_p50=%.3fs step_p99=%.3fs "
-            "host_blocked=%s metrics_dropped=%d",
+            "host_blocked=%s metrics_dropped=%d peak_hbm_gb=%s xla_compiles=%d",
             cfg.steps, final["final_loss"],
             final.get("step_time_p50_s", 0.0), final.get("step_time_p99_s", 0.0),
             final.get("host_blocked_frac", 0.0), final.get("metrics_dropped", 0),
+            final.get("peak_hbm_gb", 0.0), final["xla_compiles"],
         )
     return final
 
