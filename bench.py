@@ -774,6 +774,46 @@ def submit_latency_bench() -> dict:
     return out
 
 
+def health_overhead_bench(steps: int = 20) -> dict:
+    """Armed-vs-disarmed step-time delta for the numerics health monitors
+    (obs/health.py): the same tiny train step compiled WITH the fused
+    value monitors (nonfinite counts, update ratio, per-layer grad RMS,
+    batch fingerprint) and WITHOUT, timed back to back. The tiny model
+    deliberately OVERSTATES the relative cost — the monitors are a fixed
+    set of reductions, so their fraction shrinks as the model grows; a
+    regression that makes them expensive shows up here first."""
+    from tony_tpu.models.llama import LlamaConfig
+    from tony_tpu.parallel.mesh import MeshShape, build_mesh
+    from tony_tpu.train import trainer as tr
+
+    cfg = LlamaConfig.tiny()
+    B, S = 8, 256
+    mesh = build_mesh(MeshShape(dp=1))
+    opt = tr.default_optimizer(warmup_steps=1, decay_steps=1000)
+    inputs = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.key(2), (B, S), 0, cfg.vocab_size)
+
+    def timed(monitors: bool) -> float:
+        step = tr.make_train_step(cfg, mesh, opt, monitors=monitors)
+        state = tr.make_train_state(jax.random.key(0), cfg, mesh, opt)
+        for _ in range(3):  # compile + warm
+            state, m = step(state, inputs, targets)
+        _fence(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, inputs, targets)
+        _fence(m["loss"])
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    disarmed_ms = timed(False)
+    armed_ms = timed(True)
+    return {
+        "step_ms_disarmed": round(disarmed_ms, 3),
+        "step_ms_armed": round(armed_ms, 3),
+        "overhead_frac": round((armed_ms - disarmed_ms) / disarmed_ms, 4),
+    }
+
+
 def _phased(name: str, fn) -> dict:
     """Run one bench section under its own HBM phase watermark; the
     section's dict gains an ``hbm`` key with the phase-scoped numbers
@@ -809,6 +849,9 @@ def run_bench() -> dict:
             "decode", lambda: decode_bench(on_tpu=False)
         )
         extra["gqa_capacity"] = _phased("gqa_capacity", gqa_capacity_demo)
+        extra["health_overhead"] = _phased(
+            "health_overhead", health_overhead_bench
+        )
         return {
             "metric": "llama_tiny_cpu_tokens_per_sec",
             "value": r["tokens_per_sec_per_chip"],
@@ -883,6 +926,7 @@ def run_bench() -> dict:
     # occupancy (the decode counterpart of the training headline)
     extra["decode"] = _phased("decode", lambda: decode_bench(on_tpu=True))
     extra["gqa_capacity"] = _phased("gqa_capacity", gqa_capacity_demo)
+    extra["health_overhead"] = _phased("health_overhead", health_overhead_bench)
     extra["pipeline"] = _phased("pipeline", pipeline_bench)
     extra["submit_to_first_step_s"] = _phased(
         "submit_to_first_step_s", submit_latency_bench

@@ -207,3 +207,58 @@ def test_sp_sharded_train_step_matches_dense_head():
     dense = run(dataclasses.replace(cfg, ce_impl="dense"))
     np.testing.assert_allclose(fused, dense, rtol=2e-4)
     assert fused[-1] < fused[0]
+
+
+# --- nonfinite-input robustness (the numerics-health contract) ----------------
+
+
+@pytest.mark.parametrize("impl,kw", IMPLS, ids=IDS)
+def test_poisoned_rows_propagate_nonfinite_like_dense(hwt, impl, kw):
+    """A NaN/Inf hidden state must PROPAGATE into that row's loss (never be
+    masked away by the chunked max/logsumexp rewrites) and must not leak
+    into other rows — the per-token nonfinite mask matches the dense
+    reference exactly, and the finite tokens still value-match. The health
+    sentinel (obs/health.py) counts nonfinite losses; a kernel that
+    silently laundered a NaN would blind it."""
+    h, w, t = hwt
+    hp = h.at[0, 3].set(jnp.nan).at[1, 5].set(jnp.inf)
+    ref = np.asarray(reference_ce_tokens(hp, w, t))
+    got = np.asarray(fused_ce_tokens(hp, w, t, impl=impl, **kw))
+    # the dense reference poisons exactly the poisoned rows
+    assert np.argwhere(~np.isfinite(ref)).tolist() == [[0, 3], [1, 5]]
+    np.testing.assert_array_equal(np.isfinite(got), np.isfinite(ref))
+    finite = np.isfinite(ref)
+    np.testing.assert_allclose(got[finite], ref[finite], rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "impl,kw",
+    [("scan", dict(vocab_chunk=32)), ("pallas", dict(block_n=32, block_v=64))],
+    ids=["scan32", "pallas32x64"],
+)
+def test_poisoned_weights_propagate_through_values_and_grads(hwt, impl, kw):
+    """A NaN in lm_head touches every token through the logsumexp — values
+    AND both grads must go nonfinite exactly like the dense reference (the
+    custom_vjp bwd recomputes chunk logits; a masked recompute would
+    produce a clean-looking gradient from poisoned weights)."""
+    h, w, t = hwt
+    wp = w.at[2, 9].set(jnp.nan)
+
+    ref_v = np.asarray(reference_ce_tokens(h, wp, t))
+    got_v = np.asarray(fused_ce_tokens(h, wp, t, impl=impl, **kw))
+    assert not np.isfinite(ref_v).any()
+    assert not np.isfinite(got_v).any()
+
+    def loss_fused(h_, w_):
+        return jnp.mean(fused_ce_tokens(h_, w_, t, impl=impl, **kw))
+
+    def loss_ref(h_, w_):
+        return jnp.mean(reference_ce_tokens(h_, w_, t))
+
+    got = jax.grad(loss_fused, argnums=(0, 1))(h, wp)
+    ref = jax.grad(loss_ref, argnums=(0, 1))(h, wp)
+    for g, e, name in zip(got, ref, ("dh", "d_lm_head")):
+        assert not np.isfinite(np.asarray(e)).any(), f"ref {name} stayed finite"
+        assert not np.isfinite(np.asarray(g)).any(), (
+            f"fused {name} masked the poisoned weights back to finite values"
+        )

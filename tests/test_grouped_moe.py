@@ -293,3 +293,63 @@ def test_grouped_is_shard_map_safe():
         out_specs=P("dp", None, None),
     )(params, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expect), atol=1e-5)
+
+
+# --- nonfinite-input robustness (the numerics-health contract) ----------------
+
+
+@pytest.mark.parametrize("gmm_impl", ["scan", "pallas"])
+def test_poisoned_tokens_propagate_nonfinite_like_reference(params, x, gmm_impl):
+    """A NaN/Inf token riding into the grouped dispatch must PROPAGATE into
+    exactly that token's output — never be masked by the sorted/padded
+    group layout (padding rows are zeroed by construction; a bug that
+    zeroed a real poisoned row the same way would launder the NaN) and
+    never smear into clean tokens' outputs. The per-token nonfinite mask
+    and the clean tokens' values match the dense per-token reference (the
+    gather dispatch). The router aux loss sees every token, so it goes
+    nonfinite — the signal obs/health.py's sentinel counts."""
+    xp = x.at[0, 5].set(jnp.nan).at[1, 11].set(jnp.inf)
+
+    def out(dispatch, **kw):
+        cfg = dataclasses.replace(BASE, dispatch=dispatch, **kw)
+        return moe_block(params, xp, cfg)
+
+    y_ref, aux_ref = out("gather")
+    y_got, aux_got = out("grouped", gmm_impl=gmm_impl)
+    ref = np.asarray(y_ref)
+    got = np.asarray(y_got)
+    # the reference poisons exactly the poisoned tokens' rows
+    bad_ref = {tuple(i[:2]) for i in np.argwhere(~np.isfinite(ref))}
+    assert bad_ref == {(0, 5), (1, 11)}
+    np.testing.assert_array_equal(np.isfinite(got), np.isfinite(ref))
+    finite = np.isfinite(ref)
+    np.testing.assert_allclose(got[finite], ref[finite], atol=1e-5)
+    # the fp32 router statistics propagate the poison into the aux loss
+    assert not np.isfinite(float(aux_ref))
+    assert not np.isfinite(float(aux_got))
+
+
+@pytest.mark.parametrize("gmm_impl", ["scan", "pallas"])
+def test_poisoned_expert_weights_propagate_to_routed_tokens(params, x, gmm_impl):
+    """NaN in ONE expert's FFN weights must reach exactly the tokens routed
+    to that expert (value-matched masks vs the gather reference): the
+    grouped GEMM's block-aligned tiles touch only their expert's weights,
+    so the poison must neither vanish in padding nor leak across group
+    boundaries into other experts' tokens."""
+    bad_params = {
+        **params,
+        "w1": params["w1"].at[2].set(jnp.nan),  # poison expert 2 only
+    }
+
+    def out(dispatch, **kw):
+        cfg = dataclasses.replace(BASE, dispatch=dispatch, **kw)
+        y, _ = moe_block(bad_params, x, cfg)
+        return np.asarray(y)
+
+    ref = out("gather")
+    got = out("grouped", gmm_impl=gmm_impl)
+    # some but not all tokens hit expert 2 at top_k=2 over 4 experts
+    assert 0 < (~np.isfinite(ref)).any(axis=-1).sum() < x.shape[0] * x.shape[1]
+    np.testing.assert_array_equal(np.isfinite(got), np.isfinite(ref))
+    finite = np.isfinite(ref)
+    np.testing.assert_allclose(got[finite], ref[finite], atol=1e-5)

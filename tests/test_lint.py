@@ -380,6 +380,30 @@ class TestGL005:
         assert len(fs) == 1
         assert "disarmed" in fs[0].message and fs[0].line == 6
 
+    def test_health_sample_seam_holds_the_same_contract(self, tmp_path):
+        """The numerics sentinel's sample() seam (obs/health.py) is the
+        third observatory hook: precomputed-name arguments (the wired call
+        shape in train/loop.py and serve/engine.py) are clean; an argument
+        that allocates or calls before the armed check fires."""
+        fs = lint_src(tmp_path, {"mod.py": """
+            from tony_tpu.obs import health
+
+            def hot_loop(step, metrics, slot_rids):
+                # the wired call shapes: bare names, nothing evaluated
+                health.sample(metrics=metrics)
+                health.sample(metrics=metrics, slot_rids=slot_rids)
+                # eager call argument: evaluated even when disarmed — fires
+                health.sample(metrics=summarize(metrics))
+                # comprehension argument: ditto — fires
+                health.sample(slot_rids=[r for r in slot_rids])
+
+            def summarize(m):
+                return dict(m)
+        """}, select="GL005")
+        assert len(fs) == 2
+        assert all("disarmed" in f.message for f in fs)
+        assert sorted(f.line for f in fs) == [9, 11]
+
 
 # --- suppression / baseline machinery ----------------------------------------
 

@@ -207,11 +207,13 @@ def test_disarmed_trace_span_is_within_noise_of_noop():
 
 def test_fit_loop_stays_unblocked_with_tracing_armed(tmp_path):
     """The armed contract: with the trace spine recording at the default
-    sampling stride AND the HBM observatory sampling at its default
-    stride, the tiny-model fit loop must still clear the host-blocked
-    overlap budget — both hooks are always-on in jobs, so their cost
-    rides inside the same tier-1 guard as the data path."""
-    from tony_tpu.obs import hbm, trace
+    sampling stride AND the HBM observatory AND the numerics sentinel
+    sampling at their default strides (in-graph value monitors fused into
+    the step, rule engine evaluating async), the tiny-model fit loop must
+    still clear the host-blocked overlap budget — all three hooks are
+    always-on in jobs, so their cost rides inside the same tier-1 guard
+    as the data path."""
+    from tony_tpu.obs import hbm, health, trace
 
     tracer = trace.install(trace.Tracer(
         str(tmp_path / "trace" / "guard.jsonl"), "guard", "guardtrace",
@@ -224,6 +226,9 @@ def test_fit_loop_stays_unblocked_with_tracing_armed(tmp_path):
             "bytes_in_use": 1 << 30, "peak_bytes_in_use": 2 << 30,
         })],
         sample_every=16,  # the obs.hbm.sample_steps default
+    ))
+    health.install(health.HealthSentinel(
+        sample_every=16,  # the obs.health.sample_steps default
     ))
     try:
         final = fit(FitConfig(
@@ -238,11 +243,15 @@ def test_fit_loop_stays_unblocked_with_tracing_armed(tmp_path):
     finally:
         trace.uninstall()
         hbm.uninstall()
+        health.uninstall()
     assert np.isfinite(final["final_loss"])
     assert final["host_blocked_frac"] < MAX_HOST_BLOCKED_FRAC, (
         f"step loop is {final['host_blocked_frac']:.0%} host-blocked with "
-        "tracing + memory sampling armed — a spine is stalling the loop"
+        "tracing + memory + health sampling armed — a spine is stalling "
+        "the loop"
     )
+    # the sentinel evaluated real samples and found a clean run
+    assert final["health_verdict"] == "healthy"
     # the spine actually recorded: fit root + sampled step spans, and the
     # step-time distribution made it into the final report
     import json
@@ -298,3 +307,40 @@ def test_disarmed_hbm_sample_is_within_noise_of_noop():
         assert watch is hbm.active_watch()
     finally:
         hbm.uninstall()
+
+
+def test_disarmed_health_sample_is_within_noise_of_noop():
+    """The numerics sentinel's no-op contract (the trace-span/hbm-sample
+    twin): a sample() call with no sentinel armed is one global load +
+    None compare — cheap enough to sit in the train/serve step loops
+    unconditionally. graft-lint GL005 holds the call-site side of the
+    same contract (tests/test_lint.py has the health fixtures)."""
+    import time
+
+    from tony_tpu.obs import health
+
+    health.uninstall()  # other tests/fit runs may have armed the process
+    N = 50_000
+    for _ in range(1000):
+        health.sample()
+    per_call = math.inf
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(N):
+            health.sample()
+        per_call = min(per_call, (time.perf_counter() - t0) / N)
+    assert per_call < 5e-6, (
+        f"disarmed health.sample costs {per_call * 1e9:.0f}ns/call — the "
+        "no-op path regressed (is something arming a sentinel or allocating?)"
+    )
+    # armed-but-off-stride: one counter bump, nothing enqueued
+    sentinel = health.install(health.HealthSentinel(sample_every=1000))
+    try:
+        for _ in range(999):
+            health.sample(metrics={})
+        assert sentinel._pending == 0 and sentinel._q.empty()
+        health.sample(metrics={})
+        assert sentinel.drain(timeout_s=5.0)
+        assert sentinel is health.active_sentinel()
+    finally:
+        health.uninstall()

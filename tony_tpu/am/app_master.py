@@ -27,7 +27,7 @@ from typing import Any
 
 from tony_tpu.am.events import EventType, EventWriter
 from tony_tpu.chaos import chaos_hook
-from tony_tpu.obs import hbm, trace
+from tony_tpu.obs import hbm, health, trace
 from tony_tpu.am.scheduler import SchedulerHooks, TaskScheduler
 from tony_tpu.am.session import JobState, Session, TaskState, TERMINAL
 from tony_tpu.cluster import make_backend
@@ -145,6 +145,17 @@ class ApplicationMaster(ApplicationRpcServicer):
         )
         env[hbm.ENV_HISTORY] = str(
             self.config.get_int(Keys.OBS_HBM_HISTORY, 512)
+        )
+        # numerics-sentinel contract (obs/health.py): armed in the
+        # device-owning user process; the AM only exports the knobs
+        env[health.ENV_ENABLED] = (
+            "1" if self.config.get_bool(Keys.OBS_HEALTH_ENABLED, True) else "0"
+        )
+        env[health.ENV_SAMPLE] = str(
+            self.config.get_int(Keys.OBS_HEALTH_SAMPLE_STEPS, 16)
+        )
+        env[health.ENV_WINDOW] = str(
+            self.config.get_int(Keys.OBS_HEALTH_WINDOW, 64)
         )
         log_path = os.path.join(
             self.app_dir, "logs", f"{spec.name}_{index}_attempt{attempt}.log"

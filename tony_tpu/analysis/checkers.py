@@ -16,8 +16,8 @@ GL004    lock-discipline       blocking calls (sleep, unbounded join/wait/
                                queue-get, file I/O, RPC-ish backend/client
                                calls) while a lock is held; cross-module
                                lock-order inversions
-GL005    disarmed-hook-cost    chaos/trace/hbm hook call sites whose arguments
-                               allocate or call before the armed check
+GL005    disarmed-hook-cost    chaos/trace/hbm/health hook call sites whose
+                               arguments allocate or call before the armed check
 =======  ====================  ==============================================
 
 Checkers are tuned to under-approximate (see analysis/callgraph.py): the
@@ -709,9 +709,12 @@ class DisarmedHookCost:
             # module-level seam (trace.span); method calls on a tracer
             # object obtained after the armed check are fine
             return len(parts) == 1 or parts[-2] in ("trace", "chaos")
-        if parts[-1] == "sample" and len(parts) >= 2 and parts[-2] == "hbm":
-            # the HBM observatory's hot-path seam (obs/hbm.py): same
-            # disarmed-cost contract as the trace/chaos hooks
+        if parts[-1] == "sample" and len(parts) >= 2 and parts[-2] in (
+            "hbm", "health"
+        ):
+            # the HBM observatory's and the numerics sentinel's hot-path
+            # seams (obs/hbm.py, obs/health.py): same disarmed-cost
+            # contract as the trace/chaos hooks
             return True
         return False
 
@@ -739,7 +742,9 @@ class DisarmedHookCost:
         for mi in project.modules.values():
             # hook *implementation* modules are exempt: the seam body runs
             # after its own armed check by construction
-            if mi.modname.endswith(("obs.trace", "obs.hbm", "chaos.faults")):
+            if mi.modname.endswith(
+                ("obs.trace", "obs.hbm", "obs.health", "chaos.faults")
+            ):
                 continue
             for fi in mi.funcs.values():
                 yield from self._check_func(project, mi, fi, em)

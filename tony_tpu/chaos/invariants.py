@@ -35,6 +35,14 @@ the guarantees the module docstrings promise in prose:
     Per host, the sum of all apps' leased resources never exceeds the
     host's registered capacity — two owners can never hold the same slot.
 
+``health-verdict-surfaced``
+    A job whose numerics sentinel tripped (obs/health.py wrote a
+    ``tripped`` verdict under ``<app_dir>/health/``) must not report
+    clean: the trip is either the silent-ruin case (job SUCCEEDED on
+    NaN'd numbers) or the cause-of-death a restart decision needs — in
+    both cases the post-mortem surfaces it as a violation, never swallows
+    it into an all-clear.
+
 The checker reads the store's ``state.json`` RAW (no LeaseStore handle):
 going through the store would run its reapers and destroy the evidence.
 """
@@ -49,6 +57,7 @@ from typing import Any
 
 from tony_tpu.am.events import EventType, read_history
 from tony_tpu.cluster.lease import STATE_FILE, _pid_alive, _this_host
+from tony_tpu.obs.health import read_verdicts
 
 TERMINAL_STATES = ("SUCCEEDED", "FAILED", "KILLED")
 
@@ -165,6 +174,33 @@ def _check_job(app_dir: str, report: InvariantReport) -> tuple[str, str]:
                 )
             )
             break
+
+    # a tripped numerics verdict must reach the post-mortem reader: a
+    # SUCCEEDED job hid a ruined run, a FAILED/KILLED one died of (or
+    # with) bad numbers — either way the report cannot be clean
+    tripped = {
+        proc: v for proc, v in read_verdicts(app_dir).items()
+        if v.get("verdict") == "tripped"
+    }
+    if tripped:
+        rules = sorted({
+            rule for v in tripped.values() for rule in (v.get("rules") or {})
+        })
+        what = (
+            "job SUCCEEDED while the numerics verdict tripped — a silently "
+            "ruined run reported clean"
+            if state == "SUCCEEDED"
+            else f"job ended {state or 'without status'} with a tripped "
+            "numerics verdict — the restart decision needs the health "
+            "forensics, not an all-clear"
+        )
+        report.violations.append(
+            Violation(
+                "health-verdict-surfaced", app_id,
+                f"{what} (rules: {', '.join(rules)}; procs: "
+                f"{', '.join(sorted(tripped))})",
+            )
+        )
     return app_id, state
 
 

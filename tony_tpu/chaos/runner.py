@@ -36,6 +36,11 @@ class ChaosRunResult:
     # a RESOURCE_EXHAUSTED death during the run is a finding the
     # post-mortem must surface, not a silent exit code (obs/hbm.py)
     oom_forensics: list[str] | None = None
+    # numerics forensics bundles under <app_dir>/health/ (obs/health.py):
+    # a tripped sentinel during the run is likewise a post-mortem finding
+    # (the invariant checker separately refuses to report clean over a
+    # tripped verdict — health-verdict-surfaced)
+    health_forensics: list[str] | None = None
 
     def to_dict(self) -> dict:
         return {
@@ -45,6 +50,7 @@ class ChaosRunResult:
             "state": self.state,
             "report": self.report.to_dict(),
             "oom_forensics": self.oom_forensics or [],
+            "health_forensics": self.health_forensics or [],
         }
 
 
@@ -70,6 +76,7 @@ def run_chaos_job(config: TonyConfig, src_dir: str = "", quiet: bool = True) -> 
     report = check_invariants(
         [client.app_dir], rm_root=config.get_str(Keys.CLUSTER_RM_ROOT, "")
     )
+    from tony_tpu.obs import health
     from tony_tpu.obs.hbm import forensics_files
 
     return ChaosRunResult(
@@ -79,6 +86,7 @@ def run_chaos_job(config: TonyConfig, src_dir: str = "", quiet: bool = True) -> 
         state=state,
         report=report,
         oom_forensics=forensics_files(client.app_dir),
+        health_forensics=health.forensics_files(client.app_dir),
     )
 
 

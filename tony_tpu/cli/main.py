@@ -256,6 +256,36 @@ def cmd_compiles(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_health(args: argparse.Namespace) -> int:
+    """Roll up an application's numerics-health verdicts + forensics
+    bundles (obs/health.py; docs/OBS.md "Numerics health"). Exit 0 =
+    healthy, 1 = tripped, 2 = no health data (job predates the sentinel,
+    obs.health.enabled was false, or every process died before a verdict
+    landed — absence is reported, never read as healthy)."""
+    from tony_tpu.obs import health
+
+    app_dir = resolve_app_dir(args.app)
+    roll = health.rollup(app_dir)
+    if roll["verdict"] == "unknown":
+        print(
+            f"no health verdicts under {os.path.join(app_dir, 'health')} "
+            "(job predates the sentinel, or obs.health.enabled was false)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.bundles:
+        bundles = {}
+        for name in roll["bundles"]:
+            try:
+                with open(os.path.join(app_dir, "health", name)) as f:
+                    bundles[name] = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                bundles[name] = {"unreadable": str(e)}
+        roll["bundle_contents"] = bundles
+    print(json.dumps(roll, indent=2, sort_keys=True))
+    return 0 if roll["verdict"] == "healthy" else 1
+
+
 def cmd_lint(args: argparse.Namespace) -> int:
     """graft-lint: JAX-aware + concurrency-aware static analysis over the
     given paths (docs/ANALYSIS.md). Exit 0 = no non-baselined findings."""
@@ -384,6 +414,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("app", help="application id or app-dir path")
     s.set_defaults(fn=cmd_compiles)
+
+    s = sub.add_parser(
+        "health",
+        help="roll up an app's numerics-health verdicts and forensics "
+             "bundles (exit 0 healthy / 1 tripped / 2 no data)",
+    )
+    s.add_argument("app", help="application id or app-dir path")
+    s.add_argument(
+        "--bundles", action="store_true",
+        help="inline the forensics bundle contents into the report",
+    )
+    s.set_defaults(fn=cmd_health)
 
     s = sub.add_parser(
         "lint",

@@ -81,6 +81,19 @@ class Keys:
     OBS_HBM_SAMPLE_STEPS = "obs.hbm.sample_steps"
     # per-process in-memory sample-history ring (lands in OOM forensics)
     OBS_HBM_HISTORY = "obs.hbm.history_events"
+    # numerics health sentinel (obs/health.py; docs/OBS.md "Numerics
+    # health"): in-graph value monitors (nonfinite counts, update ratio,
+    # per-layer grad RMS, batch fingerprint, serve logits/entropy) feeding
+    # an async anomaly-rule engine; a trip flips the per-app verdict
+    # (portal /healthz, `tony health <app_id>`) and dumps a forensics
+    # bundle under <app_dir>/health/
+    OBS_HEALTH_ENABLED = "obs.health.enabled"
+    # evaluate health rules every Nth train/serve step (monitors stay
+    # fused in-graph each step; off-stride seam calls are one increment)
+    OBS_HEALTH_SAMPLE_STEPS = "obs.health.sample_steps"
+    # rolling-statistics window (loss-spike z-score, stagnation) — also
+    # the last-k step-stats ring a forensics bundle carries
+    OBS_HEALTH_WINDOW = "obs.health.window_steps"
 
     # --- cluster backend ---
     # Deliberate non-goals vs the reference key surface: docker keys (no
@@ -194,6 +207,9 @@ DEFAULTS: dict[str, object] = {
     Keys.OBS_HBM_ENABLED: True,
     Keys.OBS_HBM_SAMPLE_STEPS: 16,
     Keys.OBS_HBM_HISTORY: 512,
+    Keys.OBS_HEALTH_ENABLED: True,
+    Keys.OBS_HEALTH_SAMPLE_STEPS: 16,
+    Keys.OBS_HEALTH_WINDOW: 64,
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",

@@ -15,7 +15,12 @@ Endpoints:
     /api/job/<app_id>    full detail (JSON)
     /metrics             Prometheus text exposition over every app's
                          registry snapshots (step time / TTFT / TPOT
-                         histograms etc., labelled app= and proc=)
+                         histograms etc., labelled app= and proc=), plus
+                         the portal's own counters
+    /healthz             numerics-health verdicts for every app (JSON;
+                         obs/health.py rollup)
+    /healthz/<app_id>    one app's verdict rollup — HTTP 200 healthy/
+                         unknown, 503 tripped (probe-friendly)
 
 Run:  python -m tony_tpu.obs.portal --port 8080 [--apps-root DIR]
 """
@@ -47,7 +52,22 @@ class PortalData:
     """Filesystem read layer (kept separate from HTTP for tests)."""
 
     def __init__(self, apps_root: str):
+        from tony_tpu.obs.registry import Registry
+
         self.apps_root = apps_root
+        # the portal's own metrics, served by /metrics next to the app
+        # snapshots: hidden NaNs are what the health sentinel hunts, so a
+        # chart filter may drop them from a polyline but must COUNT them
+        self.registry = Registry()
+        self.nonfinite_dropped = self.registry.counter(
+            "tony_portal_nonfinite_dropped",
+            "non-finite metric samples excluded from portal charts "
+            "(counted, never silently hidden)",
+        )
+        # render-idempotent accounting: each distinct non-finite sample
+        # counts ONCE, however many times its page is re-rendered — the
+        # counter must track NaN production, not page views
+        self._drop_seen: set[tuple] = set()
 
     def jobs(self) -> list[dict]:
         out = []
@@ -89,6 +109,7 @@ class PortalData:
         logs_dir = os.path.join(app_dir, "logs")
         if os.path.isdir(logs_dir):
             logs = sorted(os.listdir(logs_dir))
+        self.count_drops(app_id, events)
         return {
             "app_id": app_id,
             "status": _read_json(os.path.join(app_dir, "status.json")),
@@ -96,6 +117,25 @@ class PortalData:
             "events": events,
             "logs": logs,
         }
+
+    def count_drops(self, app_id: str, events: list[dict]) -> None:
+        """Count each distinct non-finite metric sample into
+        ``tony_portal_nonfinite_dropped`` exactly once (the journal is
+        append-only, so the event index is a stable identity)."""
+        import math
+
+        for i, e in enumerate(events):
+            if e.get("type") != "METRICS" or not isinstance(
+                e.get("samples"), dict
+            ):
+                continue
+            for name, value in e["samples"].items():
+                # only floats can be non-finite; bools/ints never are
+                if isinstance(value, float) and not math.isfinite(value):
+                    key = (app_id, i, str(e.get("task", "")), name)
+                    if key not in self._drop_seen:
+                        self._drop_seen.add(key)
+                        self.nonfinite_dropped.inc()
 
     def log(self, app_id: str, name: str) -> str | None:
         if not _APP_ID_RE.match(app_id) or os.sep in name or name.startswith("."):
@@ -135,7 +175,41 @@ class PortalData:
     def prometheus(self) -> str:
         from tony_tpu.obs.registry import render_snapshots
 
-        return render_snapshots(self.metric_snapshots())
+        return render_snapshots(
+            [({"proc": "portal"}, self.registry.snapshot())]
+            + self.metric_snapshots()
+        )
+
+    def health(self, app_id: str) -> dict | None:
+        """One app's numerics-health rollup (verdicts + bundle listing,
+        obs/health.py layout); None for unknown/invalid app ids."""
+        from tony_tpu.obs.health import rollup
+
+        if not _APP_ID_RE.match(app_id):
+            return None
+        app_dir = os.path.join(self.apps_root, app_id)
+        if not os.path.isdir(app_dir):
+            return None
+        return {"app_id": app_id, **rollup(app_dir)}
+
+    def healths(self) -> dict[str, dict]:
+        """Per-app verdict map for the fleet-wide /healthz view. Apps that
+        never armed a sentinel report ``unknown`` rather than vanishing —
+        absence of a verdict is itself information."""
+        out: dict[str, dict] = {}
+        if not os.path.isdir(self.apps_root):
+            return out
+        for app_id in sorted(os.listdir(self.apps_root)):
+            if not os.path.isdir(os.path.join(self.apps_root, app_id)):
+                continue
+            h = self.health(app_id)
+            if h is not None:
+                out[app_id] = {
+                    "verdict": h["verdict"],
+                    "rules": h["rules"],
+                    "bundles": len(h["bundles"]),
+                }
+        return out
 
 
 _PAGE = """<!doctype html><html><head><title>tony-tpu portal</title><style>
@@ -180,13 +254,16 @@ def _metric_series(events: list[dict]) -> dict[str, dict[str, list[float]]]:
         if e.get("type") == "METRICS" and isinstance(e.get("samples"), dict):
             per_task = series.setdefault(str(e.get("task", "?")), {})
             for name, value in e["samples"].items():
-                # bools would chart as 0/1; NaN/Inf (a diverged loss — the
-                # moment the operator opens this page) would poison the
-                # polyline's min/max into an invisible chart
-                if (isinstance(value, (int, float))
-                        and not isinstance(value, bool)
-                        and math.isfinite(value)):
-                    per_task.setdefault(name, []).append(float(value))
+                if not isinstance(value, (int, float)) or isinstance(value, bool):
+                    continue  # bools would chart as 0/1
+                # NaN/Inf (a diverged loss — the moment the operator opens
+                # this page) would poison the polyline's min/max into an
+                # invisible chart: excluded from the line, but COUNTED
+                # once per distinct sample by PortalData.count_drops —
+                # hidden NaNs are precisely what the health sentinel hunts
+                if not math.isfinite(value):
+                    continue
+                per_task.setdefault(name, []).append(float(value))
     return series
 
 
@@ -312,6 +389,20 @@ def make_handler(data: PortalData):
                 return self._send(
                     200, data.prometheus(), "text/plain; version=0.0.4"
                 )
+            if parts[0] == "healthz":
+                if len(parts) == 1:
+                    return self._send(
+                        200, json.dumps(data.healths()), "application/json"
+                    )
+                if len(parts) == 2:
+                    h = data.health(parts[1])
+                    if h is None:
+                        return self._send(404, "{}", "application/json")
+                    # probe semantics: a tripped verdict is a 503, so a
+                    # plain HTTP check (k8s-style) needs no JSON parsing
+                    code = 503 if h["verdict"] == "tripped" else 200
+                    return self._send(code, json.dumps(h), "application/json")
+                return self._send(404, "{}", "application/json")
             if parts[0] == "api":
                 if len(parts) == 2 and parts[1] == "jobs":
                     return self._send(200, json.dumps(data.jobs()), "application/json")

@@ -48,7 +48,7 @@ import numpy as np
 from jax import lax
 
 from tony_tpu.models.llama import LlamaConfig, Params, rms_norm, rope_freqs
-from tony_tpu.obs import hbm, trace
+from tony_tpu.obs import hbm, health, trace
 from tony_tpu.obs import compiles as compile_ledger
 from tony_tpu.obs.metrics import DecodeMetrics
 from tony_tpu.obs.registry import Registry, snapshot_to_app_dir
@@ -227,6 +227,12 @@ class Engine:
         # from the decode loop, AOT decode compiles journaled with their
         # measured memory plans (obs/hbm.py, obs/compiles.py)
         hbm.install_from_env()
+        # numerics sentinel (obs/health.py): when armed, the decode step
+        # fuses per-slot logits-nonfinite counts + sampling entropy and
+        # the engine feeds them to the async rule engine with per-request
+        # attribution; disarmed, none of it is compiled in
+        health.install_from_env()
+        self._monitors = health.active_sentinel() is not None
         self._ledger = compile_ledger.get_ledger()
         self._compiles_t0 = self._ledger.backend_compiles
         # engine-scoped watermark mark: close() reports THIS engine's peak
@@ -333,6 +339,18 @@ class Engine:
         # the backend really compiled) and the engine-scoped peak-HBM
         # watermark (marked at __init__, measured by the attribution rule)
         s["xla_compiles"] = self._ledger.backend_compiles - self._compiles_t0
+        sentinel = health.active_sentinel()
+        if sentinel is not None:
+            # drain so a trip on the final decode steps reaches the summary,
+            # then export tony_health_* into this engine's registry (it is
+            # snapshotted below) and persist the verdict file
+            sentinel.drain()
+            s["health_verdict"] = sentinel.verdict
+            trips = sentinel.trip_counts()
+            if trips:
+                s["health_trips"] = trips
+            sentinel.export(self.registry)
+            sentinel.write_verdict()
         watch = hbm.active_watch()
         if watch is not None and self._hbm_mark is not None:
             peak_gb, peak_exact = watch.peak_since(self._hbm_mark)
@@ -535,7 +553,7 @@ class Engine:
             self._decode_fns[capacity] = _aot_decode(
                 self.cfg, self.serve.decode_impl, self.serve.kv_block,
                 self.serve.max_top_k, self.params, self.cache, self.state,
-                self._ledger,
+                self._ledger, monitors=self._monitors,
             )
             self.metrics.decode_compiles = len(self._decode_fns)
         return self._decode_fns[capacity]
@@ -551,9 +569,9 @@ class Engine:
             sp = tracer.sampled_span("serve.step", live=len(live_before))
         with sp:
             t0 = time.perf_counter()
-            self.cache, self.state, toks = self._get_decode(self.cache.capacity)(
-                self.params, self.cache, self.state
-            )
+            self.cache, self.state, toks, hmon = self._get_decode(
+                self.cache.capacity
+            )(self.params, self.cache, self.state)
             # EXPLICIT per-step sync: continuous batching needs the sampled
             # tokens + done flags on host to steer admission — this is the
             # engine's one designed sync point per decode step
@@ -564,6 +582,14 @@ class Engine:
             dt, len(live_before), len(live_before), self.serve.slots
         )
         hbm.sample()  # stride-counted device-memory reading (no sync)
+        if hmon:
+            # stride-counted health sample: DEVICE references + the host
+            # slot->request map for per-request trip attribution; the
+            # device_get sync happens on the sentinel's worker thread
+            slot_rids = list(self._slot_rid)
+            health.sample(
+                metrics=hmon, slot_rids=slot_rids, live_slots=live_before
+            )
         self._h_step.observe(dt)
         self._c_tokens.inc(len(live_before))
         for s in live_before:
@@ -582,6 +608,7 @@ class Engine:
             params, cache, state, cfg=self.cfg,
             decode_impl=self.serve.decode_impl,
             kv_block=self.serve.kv_block, max_top_k=self.serve.max_top_k,
+            monitors=self._monitors,
         )
 
 
@@ -596,14 +623,14 @@ def _prefill_fn(cfg: LlamaConfig, bucket: int, max_top_k: int):
 
 @functools.lru_cache(maxsize=512)
 def _decode_fn(cfg: LlamaConfig, decode_impl: str, kv_block: int,
-               max_top_k: int):
+               max_top_k: int, monitors: bool = False):
     """Jitted decode step, cached per (model config, kernel knobs) — NOT
     per capacity/slots: jit itself caches per argument shape, so all
     engines with the same model reuse every compiled signature."""
     return jax.jit(
         partial(
             _decode_step, cfg=cfg, decode_impl=decode_impl,
-            kv_block=kv_block, max_top_k=max_top_k,
+            kv_block=kv_block, max_top_k=max_top_k, monitors=monitors,
         ),
         donate_argnums=(1, 2),
     )
@@ -618,11 +645,12 @@ _aot_decode_cache: dict = {}
 
 
 def _aot_decode(cfg: LlamaConfig, decode_impl: str, kv_block: int,
-                max_top_k: int, params, cache, state, ledger):
-    fn = _decode_fn(cfg, decode_impl, kv_block, max_top_k)
+                max_top_k: int, params, cache, state, ledger, *,
+                monitors: bool = False):
+    fn = _decode_fn(cfg, decode_impl, kv_block, max_top_k, monitors)
     try:
         shard = jax.tree.leaves(params)[0].sharding
-        key = (cfg, decode_impl, kv_block, max_top_k,
+        key = (cfg, decode_impl, kv_block, max_top_k, monitors,
                cache.k.shape, str(cache.k.dtype), hash(shard), shard)
     except Exception:
         # unhashable sharding (exotic platform): lazy jit still works and
@@ -687,9 +715,12 @@ def _prefill_step(params, prompt, last_index, temp, top_k, top_p, key, *,
 
 def _decode_step(params, cache: BlockKVCache, state: _SlotState, *,
                  cfg: LlamaConfig, decode_impl: str, kv_block: int,
-                 max_top_k: int):
+                 max_top_k: int, monitors: bool = False):
     """One token for every slot: write K/V at each row's position, attend
-    over its written prefix, sample with its own stream."""
+    over its written prefix, sample with its own stream. ``monitors``
+    additionally returns the fused per-slot health monitors (logits
+    nonfinite counts + sampling entropy, obs/health.py); the dict is empty
+    when disarmed so the signature stays stable."""
     from tony_tpu.models.generate import sample_tokens
 
     S = state.last_tok.shape[0]
@@ -742,7 +773,8 @@ def _decode_step(params, cache: BlockKVCache, state: _SlotState, *,
     done = state.done | (has_eos & (nxt == state.eos))
     lengths = cache.lengths + state.live.astype(jnp.int32)
     new_state = state._replace(last_tok=nxt, rng=both[:, 1], done=done)
-    return BlockKVCache(new_k, new_v, lengths), new_state, nxt
+    hmon = health.decode_monitors(logits) if monitors else {}
+    return BlockKVCache(new_k, new_v, lengths), new_state, nxt, hmon
 
 
 

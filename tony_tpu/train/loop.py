@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from tony_tpu.models.llama import LlamaConfig, train_flops_per_token
-from tony_tpu.obs import hbm, trace
+from tony_tpu.obs import hbm, health, trace
 from tony_tpu.obs import compiles as compile_ledger
 from tony_tpu.obs.metrics import StepTimer, chip_peak_flops
 from tony_tpu.obs.registry import Registry, snapshot_to_app_dir
@@ -128,6 +128,10 @@ def fit(cfg: FitConfig) -> dict:
     # memory profile + compile ledger + watermark history into the app dir
     # before re-raising (obs/hbm.py, docs/OBS.md "Memory and compiles")
     hbm.install_from_env()
+    # arm the numerics sentinel (idempotent; TONY_OBS_HEALTH=0 disables)
+    # BEFORE the train step is built, so the in-graph value monitors are
+    # fused into it (obs/health.py, docs/OBS.md "Numerics health")
+    health.install_from_env()
     with diagnostics_context(), trace.span("train.fit", steps=cfg.steps) as root:
         with hbm.oom_guard("fit"):
             return _fit(cfg, root)
@@ -429,6 +433,10 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             else:
                 state, metrics = _dispatch(state, inputs, targets)
             hbm.sample()  # stride-counted device-memory reading (no sync)
+            # stride-counted health sample: enqueues DEVICE references for
+            # the sentinel's worker thread (the device_get sync happens
+            # there, never here — the step loop stays unblocked)
+            health.sample(metrics=metrics)
             window += 1
             if pending is not None:
                 _emit(pending)  # previous boundary, now that N+1 is in flight
@@ -497,6 +505,19 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
         reporter.close()
         if reporter.dropped:
             final["metrics_dropped"] = reporter.dropped
+    # health verdict: drain the sentinel's queue so a trip on the final
+    # steps lands in the final report, then export tony_health_* into the
+    # per-run registry (snapshotted below) and persist the verdict file
+    # the portal /healthz and `tony health` read
+    sentinel = health.active_sentinel()
+    if sentinel is not None:
+        sentinel.drain()
+        final["health_verdict"] = sentinel.verdict
+        trips = sentinel.trip_counts()
+        if trips:
+            final["health_trips"] = trips
+        sentinel.export(registry)
+        sentinel.write_verdict()
     # registry snapshot into the job history (no-op outside a tony job);
     # suffixed so a train-then-serve user process cannot overwrite one
     # component's snapshot with the other's. The HBM gauges export into

@@ -146,6 +146,7 @@ def make_train_step(
     cfg: llama.LlamaConfig, mesh: Mesh,
     optimizer: optax.GradientTransformation, rules: Rules = DEFAULT_RULES,
     *, n_microbatches: int = 0, pp_schedule: str = "gpipe",
+    monitors: bool | None = None,
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted train step:
     ``(state, inputs[B,S], targets[B,S]) -> (state, metrics)``.
@@ -154,6 +155,12 @@ def make_train_step(
     llama.loss_from_pairs) so the seq axis shards cleanly over ``sp``.
     Gradients are computed in the params' dtype (Adam's first moment is kept
     fp32 via mu_dtype); donation avoids a second copy of state.
+
+    ``monitors`` fuses the numerics-health value monitors (nonfinite
+    counts, update-to-param ratio, per-layer grad RMS, batch fingerprint —
+    obs/health.py) into the step's metrics; None resolves to "is a health
+    sentinel armed in this process", so a disarmed run compiles none of
+    them (bench.py's ``health_overhead`` measures the armed delta).
 
     A mesh with ``pp > 1`` selects a pipeline loss (layer stages over the
     ``pp`` axis, ``n_microbatches`` microbatches — default 2 per stage):
@@ -198,12 +205,33 @@ def make_train_step(
     batch_sharding = NamedSharding(mesh, spec_for(("batch", "seq"), rules))
     replicated = NamedSharding(mesh, P())
 
+    from tony_tpu.obs import health as _health
+
+    if monitors is None:
+        monitors = _health.active_sentinel() is not None
+    # numerics chaos seam: poison the REPORTED loss with an in-graph NaN
+    # from a chosen step onward (TONY_CHAOS_NAN_STEP; chaos-style jobs
+    # export it into worker env) so a tier-1 job can prove injection ->
+    # sentinel trip -> forensics end to end. Persistent like a real NaN'd
+    # state — a one-step blip could fall between sampling strides, which
+    # a genuine numerics death never does. Grads are untouched: the fault
+    # is in the value telemetry, exactly what the sentinel watches.
+    nan_step = _health.nan_inject_step()
+
     def step(state: TrainState, inputs: jax.Array, targets: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, inputs, targets)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
+        if nan_step is not None:
+            loss = loss + jnp.where(
+                state.step + 1 >= nan_step, jnp.float32(jnp.nan), jnp.float32(0.0)
+            )
         metrics = {"loss": loss, "grad_norm": gnorm, "step": state.step + 1}
+        if monitors:
+            metrics.update(_health.graph_monitors(
+                loss, grads, new_params, updates, inputs
+            ))
         return TrainState(state.step + 1, new_params, new_opt), metrics
 
     return jax.jit(
