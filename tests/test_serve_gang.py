@@ -1,0 +1,609 @@
+"""`tony serve` multi-host inference gangs (docs/SERVE.md "Gang serving").
+
+Layers under test, cheapest first: the engine's bounded-admission seam and
+deterministic re-prefill (the foundation of no-request-lost), the
+bind-with-retry TOCTOU fix, the lease store's autoscale hooks, the
+frontend's routing/admission/replay against in-process hosts, the new
+serve chaos invariants — and ONE real client -> AM -> 2-decode-host job
+where a chaos kill_container lands mid-stream and every in-flight request
+completes on the survivor with a draw-for-draw-identical replay.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tony_tpu.chaos.invariants import check_invariants
+from tony_tpu.config.config import TonyConfig
+from tony_tpu.serve.engine import AdmissionRejected, Engine, Request, ServeConfig
+from tony_tpu.serve.frontend import AutoscalePolicy, FrontendRejected, GangFrontend
+from tony_tpu.serve.gang import DecodeHostService, GangSettings, build_gang_engine
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    from tony_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    return cfg, llama.init_params(jax.random.key(0), cfg)
+
+
+def _prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(1, 200, n).astype(np.int32)
+
+
+# --- engine: bounded admission (the frontend's backpressure seam) ------------
+
+
+def test_engine_bounded_admission(tiny):
+    cfg, params = tiny
+    eng = Engine(params, cfg, ServeConfig(slots=1, max_len=32, max_queue=2))
+    for _ in range(2):
+        eng.submit(Request(prompt=_prompt(3), max_new_tokens=2))
+    assert eng.queue_depth == 2
+    with pytest.raises(AdmissionRejected, match="max_queue 2"):
+        eng.submit(Request(prompt=_prompt(3), max_new_tokens=2))
+    assert eng.rejected_total == 1
+    # the registry counter is the portal-visible twin of the exception
+    snap = {e["name"]: e for e in eng.registry.snapshot()}
+    assert snap["tony_serve_rejected_total"]["value"] == 1
+    # queue drains -> admission reopens
+    eng.run()
+    eng.submit(Request(prompt=_prompt(3), max_new_tokens=2))
+    assert eng.rejected_total == 1
+    eng.run()
+
+
+def test_engine_unbounded_by_default(tiny):
+    cfg, params = tiny
+    eng = Engine(params, cfg, ServeConfig(slots=1, max_len=32))
+    for _ in range(8):
+        eng.submit(Request(prompt=_prompt(3), max_new_tokens=1))
+    assert eng.queue_depth == 8 and eng.rejected_total == 0
+    eng.run()
+
+
+# --- deterministic re-prefill (satellite: the no-request-lost foundation) ----
+
+
+def test_deterministic_reprefill_on_fresh_engine(tiny):
+    """A request interrupted mid-decode and replayed on a FRESH engine
+    with the same rng seed reproduces identical tokens — what makes the
+    frontend's re-queue + re-prefill draw-for-draw equal to the stream
+    the dead host was producing."""
+    cfg, params = tiny
+    req = dict(prompt=_prompt(5, seed=3), max_new_tokens=12,
+               temperature=0.9, top_k=11, rng=1234)
+    # uninterrupted reference on a busy engine
+    ref_eng = Engine(params, cfg, ServeConfig(slots=2, max_len=32, kv_block=8))
+    ref_eng.submit(Request(prompt=_prompt(4, seed=9), max_new_tokens=6))
+    rid = ref_eng.submit(Request(**req))
+    ref = ref_eng.run()[rid].tokens
+    # "killed" engine: step a few times, then abandon it mid-request
+    dead = Engine(params, cfg, ServeConfig(slots=1, max_len=32))
+    drid = dead.submit(Request(**req))
+    for _ in range(4):
+        dead.step()
+    partial = list(dead.completion_of(drid).tokens)
+    assert 0 < len(partial) < 12 and not dead.completion_of(drid).finish_reason
+    # survivor: fresh engine, same seed -> identical stream, prefix included
+    surv = Engine(params, cfg, ServeConfig(slots=2, max_len=32))
+    srid = surv.submit(Request(**req))
+    replay = surv.run()[srid].tokens
+    assert replay == ref
+    assert replay[: len(partial)] == partial
+
+
+# --- utils/net: the bind TOCTOU fix ------------------------------------------
+
+
+def test_bind_with_retry_rides_out_a_stolen_port():
+    from tony_tpu.utils.net import bind_with_retry, find_free_port
+
+    port = find_free_port()
+    thief = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    thief.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    thief.bind(("127.0.0.1", port))  # the TOCTOU: someone took our pick
+
+    bound_socket = []
+
+    def release_later():
+        time.sleep(0.4)
+        thief.close()
+
+    threading.Thread(target=release_later, daemon=True).start()
+
+    def bind(p):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.bind(("127.0.0.1", p))
+        except OSError:
+            s.close()
+            raise
+        bound_socket.append(s)
+        return s.getsockname()[1]
+
+    assert bind_with_retry(bind, port, attempts=8, retry_delay_s=0.2) == port
+    bound_socket[-1].close()
+
+
+def test_bind_with_retry_bounded_failure():
+    from tony_tpu.utils.net import bind_with_retry, find_free_port
+
+    port = find_free_port()
+    holder = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    holder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    holder.bind(("127.0.0.1", port))
+    attempts = []
+
+    def bind(p):
+        attempts.append(p)
+        raise OSError("in use")
+
+    with pytest.raises(OSError, match="after 3 attempt"):
+        bind_with_retry(bind, port, attempts=3, retry_delay_s=0.01)
+    assert len(attempts) == 3
+    holder.close()
+
+
+# --- lease store: autoscale hooks --------------------------------------------
+
+
+def test_lease_grow_and_shrink_gang(tmp_path):
+    from tony_tpu.cluster.backend import Resource
+    from tony_tpu.cluster.lease import GangAsk, LeaseStore
+
+    store = LeaseStore(str(tmp_path / "rm"))
+    store.register_hosts({"h1": Resource(4096, 8, 8), "h2": Resource(4096, 8, 8)})
+    store.reserve_gang(
+        "serve-app", [GangAsk(Resource(1024, 2, 4))], gang_id="containers",
+        timeout_s=0,
+    )
+    # grow: non-blocking grant while capacity exists
+    got1 = store.grow_gang("serve-app", "autoscale", GangAsk(Resource(2048, 4, 4)))
+    got2 = store.grow_gang("serve-app", "autoscale", GangAsk(Resource(2048, 4, 4)))
+    assert got1 and got2
+    # cluster full for this ask now -> None, never a queue
+    assert store.grow_gang(
+        "serve-app", "autoscale", GangAsk(Resource(4096, 8, 8))
+    ) is None
+    # shrink hands capacity back, LIFO
+    assert store.shrink_gang("serve-app", "autoscale") == got2
+    assert store.shrink_gang("serve-app", "autoscale") == got1
+    assert store.shrink_gang("serve-app", "autoscale") is None  # gang emptied
+    summary = store.summary()
+    assert len(summary["apps"]["serve-app"]["leases"]) == 1  # original gang intact
+    # a foreign live owner's gang is refused
+    other = LeaseStore(str(tmp_path / "rm"), owner_host="elsewhere")
+    assert other.grow_gang(
+        "serve-app", "autoscale", GangAsk(Resource(64, 1, 0))
+    ) is None
+    store.release_app("serve-app")
+
+
+def test_autoscale_policy_sustained_windows():
+    pol = AutoscalePolicy(high=8, low=1, window_s=10.0)
+    t = 1000.0
+    assert pol.observe(9, t) is None            # above, window starts
+    assert pol.observe(12, t + 5) is None       # still above, not sustained
+    assert pol.observe(10, t + 11) == "grow"    # sustained a full window
+    assert pol.observe(10, t + 12) is None      # window reset after decision
+    assert pol.observe(3, t + 13) is None       # mid-band clears both windows
+    assert pol.observe(0, t + 14) is None
+    assert pol.observe(1, t + 25) == "shrink"
+    # disabled policy never decides
+    assert AutoscalePolicy(0, 0, 1.0).observe(10**6, t) is None
+
+
+# --- chaos: condition-triggered faults ---------------------------------------
+
+
+def test_chaos_on_file_trigger(tmp_path):
+    from tony_tpu.chaos import chaos_hook, install_from_config, uninstall
+    from tony_tpu.chaos.faults import parse_faults
+
+    trigger = tmp_path / "go"
+    specs = parse_faults(json.dumps(
+        [{"type": "drop_heartbeats", "on_file": str(trigger), "from_count": 1}]
+    ))
+    assert specs[0].on_file == str(trigger)
+    assert "on_file" in specs[0].describe()
+    cfg = TonyConfig({
+        "chaos.enabled": True,
+        "chaos.faults": json.dumps(
+            [{"type": "drop_heartbeats", "on_file": str(trigger)}]
+        ),
+    })
+    try:
+        assert install_from_config(cfg, role="executor") is True
+        assert chaos_hook("executor.beat", task="w:0") is None  # file absent
+        trigger.write_text("")
+        assert chaos_hook("executor.beat", task="w:0") is not None
+    finally:
+        uninstall()
+
+
+# --- serve invariants over fabricated ledgers --------------------------------
+
+
+def _app_with_ledger(tmp_path, name, ledger):
+    app = tmp_path / name
+    (app / "serve").mkdir(parents=True)
+    (app / "events").mkdir()
+    (app / "status.json").write_text(
+        json.dumps({"state": "SUCCEEDED", "exit_code": 0, "tasks": []})
+    )
+    (app / "events" / f"{name}.jhist.jsonl").write_text(
+        json.dumps({"type": "APPLICATION_FINISHED", "ts": 0, "state": "SUCCEEDED"})
+        + "\n"
+    )
+    (app / "serve" / "requests_frontend.json").write_text(json.dumps(ledger))
+    return str(app)
+
+
+def test_serve_invariants_flag_losses_and_pass_clean(tmp_path):
+    clean = _app_with_ledger(tmp_path, "clean-app", {
+        "proc": "frontend", "ttft_budget_s": 5.0, "rejected": 1, "pending": [],
+        "requests": [
+            {"rid": "r1", "tokens": 8, "finish_reason": "length",
+             "ttft_s": 0.2, "replays": 1, "replay_consistent": True},
+            {"rid": "r2", "tokens": 3, "finish_reason": "eos",
+             "ttft_s": 0.1, "replays": 0, "replay_consistent": True},
+            # explicit rejection is backpressure, not a loss
+            {"rid": "r3", "tokens": 0, "finish_reason": "rejected",
+             "ttft_s": 0.0, "replays": 0, "replay_consistent": True},
+        ],
+    })
+    assert check_invariants([clean]).ok
+
+    bad = _app_with_ledger(tmp_path, "lossy-app", {
+        "proc": "frontend", "ttft_budget_s": 1.0, "pending": ["r9"],
+        "requests": [
+            {"rid": "r1", "tokens": 0, "finish_reason": "error",
+             "message": "replay budget exhausted", "ttft_s": 0.0,
+             "replays": 3, "replay_consistent": True},
+            {"rid": "r2", "tokens": 8, "finish_reason": "length",
+             "ttft_s": 0.2, "replays": 1, "replay_consistent": False},
+            {"rid": "r3", "tokens": 8, "finish_reason": "length",
+             "ttft_s": 4.0, "replays": 0, "replay_consistent": True},
+        ],
+    })
+    report = check_invariants([bad])
+    kinds = [(v.invariant, v.detail) for v in report.violations]
+    assert sum(1 for k, _ in kinds if k == "serve-no-request-lost") == 3
+    assert any("never completed" in d for _, d in kinds)
+    assert any("NON-deterministically" in d for _, d in kinds)
+    assert any(k == "serve-ttft-bounded" for k, _ in kinds)
+
+
+def test_portal_serve_rollup(tmp_path):
+    from tony_tpu.obs.portal import PortalData
+
+    _app_with_ledger(tmp_path, "served-app", {
+        "proc": "frontend", "rejected": 2, "pending": [],
+        "requests": [
+            {"rid": "r1", "tokens": 8, "finish_reason": "length",
+             "ttft_s": 0.7, "replays": 1, "replay_consistent": True},
+            {"rid": "r2", "tokens": 0, "finish_reason": "error",
+             "ttft_s": 0.0, "replays": 3, "replay_consistent": True},
+            # explicit backpressure: counts as rejected, NOT an error —
+            # same semantics as the serve-no-request-lost invariant
+            {"rid": "r3", "tokens": 0, "finish_reason": "rejected",
+             "ttft_s": 0.0, "replays": 0, "replay_consistent": True},
+        ],
+    })
+    data = PortalData(str(tmp_path))
+    s = data.serve_summary("served-app")
+    assert s["requests"] == 3 and s["finished"] == 1 and s["errors"] == 1
+    assert s["replays"] == 4 and s["rejected"] == 3
+    assert s["ttft_max_s"] == 0.7
+    fleet = data.serve_summaries()
+    assert list(fleet) == ["served-app"]
+    assert data.serve_summary("no-such-app!") is None
+
+
+# --- settings / runtime export -----------------------------------------------
+
+
+def test_gang_settings_roundtrip_and_runtime_env():
+    from tony_tpu.runtime import make_runtime
+    from tony_tpu.runtime.base import TaskIdentity
+
+    cfg = TonyConfig({
+        "serve.gang.hosts": 3, "serve.gang.model": "tiny",
+        "serve.gang.slots": 2, "serve.gang.max_queue": 5,
+        "serve.gang.ttft_budget_s": 2.5,
+        "job.decode.instances": 3,
+    })
+    settings = GangSettings.from_config(cfg)
+    assert settings.hosts == 3 and settings.max_queue == 5
+    assert GangSettings.from_json(settings.to_json()) == settings
+
+    rt = make_runtime("serve")
+    rt.validate(cfg)
+    identity = TaskIdentity(
+        job_name="decode", index=1,
+        cluster_spec={"decode": ["h0:7001", "h1:7002", "h2:7003"]},
+        coordinator_address="h0:7001", process_id=1, num_processes=3,
+    )
+    env = rt.build_env(identity, cfg)
+    assert env["TONY_SERVE_PORT"] == "7002"
+    assert GangSettings.from_json(env["TONY_SERVE_GANG"]) == settings
+    # validate refuses a serve job with no gang task type configured
+    with pytest.raises(ValueError, match=r"\[job.decode\]"):
+        rt.validate(TonyConfig({"serve.gang.hosts": 2}))
+
+
+# --- frontend against in-process hosts ---------------------------------------
+
+
+def _start_host(settings, i):
+    from tony_tpu.rpc import serve_rpc
+
+    svc = DecodeHostService(lambda: build_gang_engine(settings), f"decode:{i}")
+    server, port = serve_rpc(svc, host="127.0.0.1", port=0)
+    svc.start()
+    return svc, server, port
+
+
+def test_frontend_routes_fails_over_and_drains():
+    """In-process gang of 2: batch completes across both hosts; a hard
+    host kill mid-stream re-queues + re-prefills on the survivor with the
+    delivered prefix verified; rolling drain recycles the survivor."""
+    settings = GangSettings(
+        model="tiny", slots=2, max_len=128, max_queue=8, max_replays=3,
+    )
+    h0 = _start_host(settings, 0)
+    h1 = _start_host(settings, 1)
+    fe = GangFrontend("", settings)
+    fe.add_host("decode:0", f"127.0.0.1:{h0[2]}")
+    fe.add_host("decode:1", f"127.0.0.1:{h1[2]}")
+    try:
+        done = fe.run([_prompt(3), _prompt(4), _prompt(5)], max_new_tokens=8)
+        assert len(done) == 3
+        assert all(c.finish_reason == "length" for c in done.values())
+        used = {h for c in done.values() for h in c.hosts}
+        assert used == {"decode:0", "decode:1"}  # least-loaded spreads
+
+        # kill decode:0 mid-stream
+        rids = [fe.submit(_prompt(4, seed=i), 60) for i in range(4)]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with fe._lock:
+                flights = list(fe._flights.values())
+            if any(
+                f.result.tokens and f.result.hosts[-1] == "decode:0"
+                for f in flights
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("no stream reached decode:0 in time")
+        h0[1].stop(None)  # hard server death -> RpcError mid-stream
+        res = {rid: fe.result(rid, timeout_s=120) for rid in rids}
+        assert all(
+            c.finish_reason == "length" and len(c.tokens) == 60
+            and c.replay_consistent
+            for c in res.values()
+        ), res
+        assert sum(c.replays for c in res.values()) >= 1
+
+        # deterministic validation failures do not burn replays
+        bad = fe.result(fe.submit(_prompt(3), 500), timeout_s=30)
+        assert bad.finish_reason == "rejected" and bad.replays == 0
+
+        # rolling restart: survivor drains + recycles while registered
+        restarted = fe.rolling_restart(recycle=True, timeout_s=10.0)
+        assert restarted == ["decode:1"]
+        after = fe.run([_prompt(6)], max_new_tokens=4)
+        assert all(c.finish_reason == "length" for c in after.values())
+
+        ledger = fe.close()
+        assert not ledger["pending"]
+        entries = {e["rid"]: e for e in ledger["requests"]}
+        assert all(
+            e["replay_consistent"] for e in entries.values()
+        )
+    finally:
+        fe._closed.set()
+        h0[0].shutdown()
+        h1[0].shutdown()
+        h1[1].stop(0)
+
+
+def test_frontend_bounded_admission():
+    settings = GangSettings(frontend_max_inflight=2)
+    fe = GangFrontend("", settings)
+    try:
+        fe.submit(_prompt(3), 4)
+        fe.submit(_prompt(3), 4)
+        with pytest.raises(FrontendRejected, match="max_inflight 2"):
+            fe.submit(_prompt(3), 4)
+        assert fe._c_rejected.value == 1
+    finally:
+        fe.close(wait_s=0.0)
+
+
+def test_frontend_autoscale_tick_calls_store_hooks(tmp_path):
+    from tony_tpu.cluster.backend import Resource
+    from tony_tpu.cluster.lease import GangAsk, LeaseStore
+
+    store = LeaseStore(str(tmp_path / "rm"))
+    store.register_hosts({"h1": Resource(8192, 8, 8)})
+    store.reserve_gang(
+        "serve-auto", [GangAsk(Resource(1024, 1, 0))], timeout_s=0
+    )
+    settings = GangSettings(
+        autoscale_queue_high=4, autoscale_queue_low=0, autoscale_window_s=1.0,
+    )
+    fe = GangFrontend(
+        "", settings, lease_store=store, app_id="serve-auto",
+        grow_ask=GangAsk(Resource(2048, 2, 4)),  # the real container shape
+    )
+    try:
+        t = 100.0
+        assert fe.autoscale_tick(10, t) is None
+        assert fe.autoscale_tick(10, t + 1.5) == "grow"
+        assert fe.autoscale_tick(0, t + 2.0) is None
+        assert fe.autoscale_tick(0, t + 3.6) == "shrink"
+        actions = [a for a, _ in fe.autoscale_actions]
+        assert actions == ["grow", "shrink"]
+        # the grow leased the REAL container shape and the shrink returned it
+        grow_detail = fe.autoscale_actions[0][1]
+        assert "leased h1" in grow_detail
+        leases = store.summary()["apps"]["serve-auto"]["leases"]
+        assert len(leases) == 1
+        # without a grow_ask the decision is recorded but nothing is leased
+        fe2 = GangFrontend(
+            "", settings, lease_store=store, app_id="serve-auto",
+        )
+        try:
+            t2 = 200.0
+            fe2.autoscale_tick(10, t2)
+            assert fe2.autoscale_tick(10, t2 + 1.5) == "grow"
+            assert "no grow_ask" in fe2.autoscale_actions[0][1]
+            assert len(store.summary()["apps"]["serve-auto"]["leases"]) == 1
+        finally:
+            fe2.close(wait_s=0.0)
+    finally:
+        fe.close(wait_s=0.0)
+        store.release_app("serve-auto")
+
+
+# --- THE e2e: chaos kill_container on a decode host mid-stream ---------------
+
+
+def test_gang_serve_e2e_kill_container_midstream(tmp_path):
+    """Acceptance: a REAL client -> AM -> 2-decode-host serve job; chaos
+    SIGKILLs decode:0's container the heartbeat after the test observes a
+    stream mid-flight on it (the on_file trigger). Every in-flight request
+    completes on the survivor, the serve-no-request-lost invariant passes
+    over the frontend's ledger, and the merged `tony trace` carries the
+    serve.reprefill span parented on the original request span."""
+    from tony_tpu.cli.client import TonyClient
+    from tony_tpu.cli.main import main as cli_main
+    from tony_tpu.obs import trace
+    from tony_tpu.rpc import ApplicationRpcClient
+
+    trigger = tmp_path / "kill-now"
+    cfg = TonyConfig.load(overrides={
+        "task.heartbeat_interval_ms": 200,
+        "task.max_missed_heartbeats": 20,
+        "application.timeout_s": 300,
+        "application.stage_dir": str(tmp_path),
+        "application.name": "serve-gang-kill",
+        "application.framework": "serve",
+        "serve.gang.hosts": 2,
+        "serve.gang.model": "tiny",
+        "serve.gang.slots": 2,
+        "serve.gang.max_len": 256,
+        "serve.gang.max_queue": 8,
+        "serve.gang.ttft_budget_s": 120,
+        "job.decode.instances": 2,
+        "job.decode.command": f"{sys.executable} -m tony_tpu.serve.gang",
+        "job.decode.env": ["JAX_PLATFORMS=cpu"],
+        "chaos.enabled": True,
+        "chaos.faults": json.dumps([{
+            "type": "kill_container", "task": "decode:0",
+            "on_file": str(trigger),
+        }]),
+        "trace.sample_steps": 1,
+    })
+    client = TonyClient(cfg)
+    client.stage()
+    client.launch_am()
+    app_dir = client.app_dir
+    fe = None
+    try:
+        am_addr = client.am_address(timeout_s=60.0)
+        trace.install_from_config(cfg, app_dir, client.app_id, proc="frontend")
+        fe = GangFrontend(
+            am_addr, GangSettings.from_config(cfg), app_dir=app_dir,
+        )
+        fe.wait_ready(2, timeout_s=150.0)
+        rids = [fe.submit(_prompt(4, seed=i), 160) for i in range(4)]
+        # arm the kill only once a stream is provably mid-flight on decode:0
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            with fe._lock:
+                flights = list(fe._flights.values())
+            live0 = [
+                f for f in flights
+                if f.result.tokens and f.result.hosts
+                and f.result.hosts[-1] == "decode:0" and not f.done.is_set()
+            ]
+            if live0:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("no stream reached decode:0 before the kill window")
+        trigger.write_text("")  # next decode:0 heartbeat (<=200ms): SIGKILL
+        res = {rid: fe.result(rid, timeout_s=180.0) for rid in rids}
+        for rid, c in res.items():
+            assert c.finish_reason == "length" and len(c.tokens) == 160, (rid, c)
+            assert c.replay_consistent, (rid, c)
+        replayed = [c for c in res.values() if c.replays]
+        assert replayed, "the kill interrupted nothing? (fault did not land)"
+        assert any(
+            c.hosts[0] == "decode:0" and c.hosts[-1] != "decode:0"
+            for c in replayed
+        )
+        fe.close()
+        fe = None
+    finally:
+        if fe is not None:
+            fe.close(wait_s=0.0)
+        try:
+            with ApplicationRpcClient(
+                client.am_address(timeout_s=5.0), timeout_s=5.0
+            ) as c:
+                c.stop_application("serve e2e done")
+        except Exception:
+            pass
+        code = client.monitor(quiet=True)
+        trace.uninstall()  # flush the frontend journal before reading it
+    assert code == 143  # deliberate stop -> KILLED
+
+    status = json.load(open(os.path.join(app_dir, "status.json")))
+    assert status["state"] == "KILLED"
+    # decode:0 went around (failed_only restart of the killed host)
+    attempts = {t["task"]: t["attempts"] for t in status["tasks"]}
+    assert attempts["decode:0"] >= 2 and attempts["decode:1"] == 1
+
+    # the serving contracts hold post-mortem
+    report = check_invariants([app_dir])
+    assert report.ok, report.to_json()
+    ledger = json.load(
+        open(os.path.join(app_dir, "serve", "requests_frontend.json"))
+    )
+    assert len(ledger["requests"]) == 4 and not ledger["pending"]
+    assert any(e["replays"] for e in ledger["requests"])
+
+    # the re-prefill span parents on the original request span, and the
+    # merged `tony trace` renders both
+    recs = []
+    with open(os.path.join(app_dir, "trace", "frontend.jsonl")) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    reqs = {
+        r["sid"]: r for r in recs
+        if r.get("ph") == "X" and r["name"] == "serve.request"
+    }
+    refills = [
+        r for r in recs if r.get("ph") == "X" and r["name"] == "serve.reprefill"
+    ]
+    assert refills, "no serve.reprefill span journaled"
+    for r in refills:
+        assert r["psid"] in reqs
+        assert reqs[r["psid"]]["args"]["rid"] == r["args"]["rid"]
+    assert cli_main(["trace", app_dir]) == 0
+    merged = json.load(open(os.path.join(app_dir, "trace.json")))
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert {"serve.request", "serve.reprefill", "chaos.kill_container"} <= names

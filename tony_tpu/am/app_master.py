@@ -86,6 +86,26 @@ class ApplicationMaster(ApplicationRpcServicer):
         self._max_missed = config.get_int(Keys.TASK_MAX_MISSED_HEARTBEATS, 25)
         self._restart_policy = config.get_str(Keys.RESTART_POLICY, "never")
         self._max_restarts = config.get_int(Keys.RESTART_MAX_WORKER_RESTARTS, 0)
+        if (
+            config.get_str(Keys.APPLICATION_FRAMEWORK) == "serve"
+            and self._restart_policy == "never"
+        ):
+            # gang-serving supervision: decode hosts are SERVICES. Under
+            # `never` (the training-oriented baked default) one container
+            # death fails the whole job and tears down every survivor
+            # mid-stream — the opposite of the serving contract, where the
+            # frontend re-queues the dead host's in-flight requests onto
+            # survivors while the AM relaunches just the lost host. Jobs
+            # that really want never can set restart.policy explicitly
+            # alongside a max_worker_restarts of 0.
+            self._restart_policy = "failed_only"
+            if self._max_restarts <= 0:
+                self._max_restarts = 2
+            log.warning(
+                "serve job: restart.policy never -> failed_only "
+                "(max_worker_restarts %d): a lost decode host relaunches "
+                "alone while survivors keep serving", self._max_restarts,
+            )
         self._latest_metrics: dict[str, dict[str, float]] = {}
         self._last_metrics_event: dict[str, float] = {}
         self._step_metric_seen: set[str] = set()

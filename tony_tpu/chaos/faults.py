@@ -99,6 +99,11 @@ class FaultSpec:
     to_count: int = 0         # ...up to this one (0 = no upper bound)
     after_s: float = 0.0      # fire only this long after arming...
     until_s: float = 0.0      # ...and before this (0 = no upper bound)
+    # condition trigger: fire only once this file exists — the test/driver
+    # creates it when the system reaches the state under attack (e.g. "kill
+    # the decode host only once its streams are provably mid-flight"),
+    # which count/time triggers can only approximate racily
+    on_file: str = ""
     duration_s: float = 30.0  # hang_store block length
     delay_ms: float = 0.0     # delay_rpc / delay_point latency
     jitter_ms: float = 0.0    # extra random latency from the seeded RNG
@@ -120,6 +125,8 @@ class FaultSpec:
             v = getattr(self, name)
             if v:
                 parts.append(f"{name}={v:g}")
+        if self.on_file:
+            parts.append(f"on_file={self.on_file}")
         return " ".join(parts)
 
 
@@ -159,7 +166,7 @@ def parse_faults(raw: Any) -> list[FaultSpec]:
         known = {
             "type", "point", "role", "task", "method", "attempt", "at_count",
             "from_count", "to_count", "after_s", "until_s", "duration_s",
-            "delay_ms", "jitter_ms",
+            "delay_ms", "jitter_ms", "on_file",
         }
         unknown = set(d) - known
         if unknown:
@@ -181,6 +188,7 @@ def parse_faults(raw: Any) -> list[FaultSpec]:
                 duration_s=float(d.get("duration_s", 30.0)),
                 delay_ms=float(d.get("delay_ms", 0.0)),
                 jitter_ms=float(d.get("jitter_ms", 0.0)),
+                on_file=str(d.get("on_file", "")),
                 raw=dict(d),
             )
         )
@@ -236,6 +244,8 @@ class ChaosInjector:
         if f.after_s and now < f.after_s:
             return False
         if f.until_s and now > f.until_s:
+            return False
+        if f.on_file and not os.path.exists(f.on_file):
             return False
         return True
 

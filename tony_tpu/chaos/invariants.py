@@ -43,6 +43,21 @@ the guarantees the module docstrings promise in prose:
     both cases the post-mortem surfaces it as a violation, never swallows
     it into an all-clear.
 
+``serve-no-request-lost``
+    Over every serving ledger the gang frontend left under
+    ``<app_dir>/serve/`` (docs/SERVE.md "Gang serving"): every ACCEPTED
+    request completed (finish_reason eos/length, with tokens) — a host
+    killed mid-stream must have had its in-flight requests re-queued and
+    re-prefilled on a survivor, never dropped — and every replay was
+    draw-for-draw deterministic (``replay_consistent``): the regenerated
+    prefix matched what was already delivered. Explicit admission
+    rejections are NOT losses; silent disappearance is.
+
+``serve-ttft-bounded``
+    When the ledger records a TTFT contract (``serve.gang.ttft_budget_s``
+    > 0), no completed request's time-to-first-token exceeded it — the
+    bounded-TTFT-under-kill serving contract.
+
 The checker reads the store's ``state.json`` RAW (no LeaseStore handle):
 going through the store would run its reapers and destroy the evidence.
 """
@@ -201,7 +216,74 @@ def _check_job(app_dir: str, report: InvariantReport) -> tuple[str, str]:
                 f"{', '.join(sorted(tripped))})",
             )
         )
+    _check_serve_ledgers(app_dir, app_id, report)
     return app_id, state
+
+
+def _check_serve_ledgers(app_dir: str, app_id: str, report: InvariantReport) -> None:
+    """Audit the gang frontend's request ledgers (serve/frontend.py):
+    no accepted request lost, replays deterministic, TTFT under contract."""
+    serve_dir = os.path.join(app_dir, "serve")
+    if not os.path.isdir(serve_dir):
+        return
+    names = sorted(
+        n for n in os.listdir(serve_dir)
+        if n.startswith("requests_") and n.endswith(".json")
+    )
+    for name in names:
+        try:
+            with open(os.path.join(serve_dir, name)) as f:
+                ledger = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            report.violations.append(
+                Violation(
+                    "serve-no-request-lost", app_id,
+                    f"unreadable serve ledger {name}: {e}",
+                )
+            )
+            continue
+        subject = f"{app_id}/{name}"
+        budget = float(ledger.get("ttft_budget_s", 0) or 0)
+        for rid in ledger.get("pending", []):
+            report.violations.append(
+                Violation(
+                    "serve-no-request-lost", subject,
+                    f"request {rid} was accepted but never completed "
+                    "(still pending at ledger time)",
+                )
+            )
+        for entry in ledger.get("requests", []):
+            rid = entry.get("rid", "?")
+            reason = entry.get("finish_reason", "")
+            if reason in ("rejected", "draining"):
+                continue  # explicit backpressure, not a loss
+            if reason not in ("eos", "length") or not entry.get("tokens"):
+                report.violations.append(
+                    Violation(
+                        "serve-no-request-lost", subject,
+                        f"request {rid} ended {reason or 'nowhere'} with "
+                        f"{entry.get('tokens', 0)} token(s): "
+                        f"{entry.get('message', '')}",
+                    )
+                )
+                continue
+            if not entry.get("replay_consistent", True):
+                report.violations.append(
+                    Violation(
+                        "serve-no-request-lost", subject,
+                        f"request {rid} replayed NON-deterministically "
+                        f"(the regenerated prefix diverged after "
+                        f"{entry.get('replays', 0)} replay(s))",
+                    )
+                )
+            if budget > 0 and float(entry.get("ttft_s", 0.0)) > budget:
+                report.violations.append(
+                    Violation(
+                        "serve-ttft-bounded", subject,
+                        f"request {rid} TTFT {entry.get('ttft_s')}s exceeds "
+                        f"the {budget}s contract",
+                    )
+                )
 
 
 def _check_store(rm_root: str, terminal_apps: dict[str, str], report: InvariantReport) -> None:

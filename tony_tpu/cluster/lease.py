@@ -679,6 +679,72 @@ class LeaseStore:
             ]
         return True
 
+    # --- autoscale hooks (gang serving; serve/frontend.py) ------------------
+
+    def grow_gang(self, app_id: str, gang_id: str, ask: GangAsk) -> str | None:
+        """Append ONE ask to an existing (or new) gang reservation if it
+        fits current availability RIGHT NOW — the non-blocking grow hook
+        the serving autoscaler calls on sustained queue depth. Returns
+        the granted host, or None when no capacity is free (the
+        autoscaler retries on its own cadence; queueing here would wedge
+        a live serving job behind a batch ticket). Same ownership rules
+        as release: only the app's owner (or a fresh app entry) may grow
+        it."""
+        with self._locked() as state:
+            app = state["apps"].get(app_id)
+            if app is not None and not self._owned_by_caller(app):
+                log.warning(
+                    "refusing to grow gang %r of %s: owned by live %s:%s",
+                    gang_id, app_id, app.get("owner_host"), app.get("owner_pid"),
+                )
+                return None
+            if not state["hosts"]:
+                return None
+            packing = self._try_pack(state, [ask])
+            if packing is None:
+                return None
+            for gang in (app or {}).get("gangs", ()):
+                if gang["gang_id"] == gang_id:
+                    gang["asks"].append(ask.to_json())
+                    gang["hosts"].append(packing[0])
+                    self._touch_entries(state, app_id)
+                    break
+            else:
+                self._commit(
+                    state, app_id, gang_id, [ask.to_json()], packing,
+                    self._owner_host,
+                )
+            return packing[0]
+
+    def shrink_gang(self, app_id: str, gang_id: str) -> str | None:
+        """Drop the LAST ask of a gang reservation (the shrink hook:
+        sustained idle queue hands a host's capacity back to the cluster
+        BEFORE job end). Returns the freed host, or None when the gang
+        has nothing to shrink. An emptied gang is removed like
+        release_gang would."""
+        with self._locked() as state:
+            app = state["apps"].get(app_id)
+            if app is None:
+                return None
+            if not self._owned_by_caller(app) and not self._entry_dead(app):
+                log.warning(
+                    "refusing to shrink gang %r of %s: owned by live %s:%s",
+                    gang_id, app_id, app.get("owner_host"), app.get("owner_pid"),
+                )
+                return None
+            for gang in app["gangs"]:
+                if gang["gang_id"] == gang_id and gang["asks"]:
+                    gang["asks"].pop()
+                    freed = gang["hosts"].pop()
+                    if not gang["asks"]:
+                        app["gangs"] = [
+                            g for g in app["gangs"] if g["gang_id"] != gang_id
+                        ]
+                        if not app["gangs"]:
+                            state["apps"].pop(app_id, None)
+                    return freed
+            return None
+
     def release_gang(self, app_id: str, gang_id: str) -> bool:
         """Release ONE gang of an app while its other reservations stay
         live — the rollback path for a losing on-demand lease (the backend

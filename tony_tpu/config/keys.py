@@ -95,6 +95,46 @@ class Keys:
     # the last-k step-stats ring a forensics bundle carries
     OBS_HEALTH_WINDOW = "obs.health.window_steps"
 
+    # --- gang serving (`tony serve`; serve/gang.py + serve/frontend.py) ---
+    # decode-host containers the AM gang-schedules (the serve job's size)
+    SERVE_GANG_HOSTS = "serve.gang.hosts"
+    # task-type name of the decode hosts (job.<type>.* keys configure their
+    # containers; the command defaults to `python -m tony_tpu.serve.gang`)
+    SERVE_GANG_JOB_TYPE = "serve.gang.job_type"
+    # model preset each host builds: a LlamaConfig classmethod name
+    # (tiny | bench_410m | bench_1b4 | ...)
+    SERVE_GANG_MODEL = "serve.gang.model"
+    # parameter-init seed: every replica derives identical weights from it,
+    # so any host can serve (or replay) any request
+    SERVE_GANG_SEED = "serve.gang.seed"
+    # per-host engine shape (ServeConfig.slots / max_len; 0 = model max)
+    SERVE_GANG_SLOTS = "serve.gang.slots"
+    SERVE_GANG_MAX_LEN = "serve.gang.max_len"
+    # per-host bounded admission (ServeConfig.max_queue): submits beyond
+    # this queue depth are rejected so the frontend reroutes instead of
+    # burying work in a saturated host
+    SERVE_GANG_MAX_QUEUE = "serve.gang.max_queue"
+    # shard each host's params over its local devices via the default mesh
+    # (parallel/mesh.py) instead of single-device replication
+    SERVE_GANG_SHARD = "serve.gang.shard"
+    # frontend admission bound: total requests in flight across the gang
+    SERVE_GANG_MAX_INFLIGHT = "serve.gang.frontend_max_inflight"
+    # replay budget per request: a request re-queued off a dead host more
+    # than this many times finishes with reason=error (never hangs)
+    SERVE_GANG_MAX_REPLAYS = "serve.gang.max_replays"
+    # TTFT contract recorded into the serve ledger; the chaos invariant
+    # checker flags completed requests over budget (0 = uncontracted)
+    SERVE_GANG_TTFT_BUDGET_S = "serve.gang.ttft_budget_s"
+    # rolling-restart drain: how long a host finishes its live slots
+    # before Drain gives up and reports the remainder
+    SERVE_GANG_DRAIN_TIMEOUT_S = "serve.gang.drain_timeout_s"
+    # lease-store autoscale hooks: grow the gang when the aggregate queue
+    # depth stays above `high` for `window_s`, shrink when it stays below
+    # `low` (high 0 disables; see LeaseStore.grow_gang/shrink_gang)
+    SERVE_GANG_AUTOSCALE_HIGH = "serve.gang.autoscale_queue_high"
+    SERVE_GANG_AUTOSCALE_LOW = "serve.gang.autoscale_queue_low"
+    SERVE_GANG_AUTOSCALE_WINDOW_S = "serve.gang.autoscale_window_s"
+
     # --- cluster backend ---
     # Deliberate non-goals vs the reference key surface: docker keys (no
     # container runtime in this environment — processes are the container
@@ -210,6 +250,21 @@ DEFAULTS: dict[str, object] = {
     Keys.OBS_HEALTH_ENABLED: True,
     Keys.OBS_HEALTH_SAMPLE_STEPS: 16,
     Keys.OBS_HEALTH_WINDOW: 64,
+    Keys.SERVE_GANG_HOSTS: 2,
+    Keys.SERVE_GANG_JOB_TYPE: "decode",
+    Keys.SERVE_GANG_MODEL: "tiny",
+    Keys.SERVE_GANG_SEED: 0,
+    Keys.SERVE_GANG_SLOTS: 4,
+    Keys.SERVE_GANG_MAX_LEN: 0,
+    Keys.SERVE_GANG_MAX_QUEUE: 16,
+    Keys.SERVE_GANG_SHARD: False,
+    Keys.SERVE_GANG_MAX_INFLIGHT: 64,
+    Keys.SERVE_GANG_MAX_REPLAYS: 3,
+    Keys.SERVE_GANG_TTFT_BUDGET_S: 0,
+    Keys.SERVE_GANG_DRAIN_TIMEOUT_S: 30,
+    Keys.SERVE_GANG_AUTOSCALE_HIGH: 0,
+    Keys.SERVE_GANG_AUTOSCALE_LOW: 0,
+    Keys.SERVE_GANG_AUTOSCALE_WINDOW_S: 10,
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",

@@ -13,6 +13,10 @@ Endpoints:
     /job/<app_id>/log/<task>   task log (text)
     /api/jobs            jobs list (JSON)
     /api/job/<app_id>    full detail (JSON)
+    /api/serve           fleet gang-serving rollup: per-app request /
+                         replay / rejection counts from the frontend
+                         ledgers under <app_dir>/serve/ (JSON)
+    /api/serve/<app_id>  one app's serving rollup (JSON)
     /metrics             Prometheus text exposition over every app's
                          registry snapshots (step time / TTFT / TPOT
                          histograms etc., labelled app= and proc=), plus
@@ -179,6 +183,64 @@ class PortalData:
             [({"proc": "portal"}, self.registry.snapshot())]
             + self.metric_snapshots()
         )
+
+    def serve_summary(self, app_id: str) -> dict | None:
+        """Roll up one app's gang-serving ledgers (serve/frontend.py
+        writes ``<app_dir>/serve/requests_*.json``): request counts by
+        finish reason, replays, rejected, worst TTFT — the fleet view of
+        the no-request-lost contract. None for unknown app ids, a zeroed
+        summary for jobs that never served."""
+        if not _APP_ID_RE.match(app_id):
+            return None
+        app_dir = os.path.join(self.apps_root, app_id)
+        if not os.path.isdir(app_dir):
+            return None
+        out = {
+            "app_id": app_id, "requests": 0, "finished": 0, "errors": 0,
+            "replays": 0, "rejected": 0, "pending": 0, "ttft_max_s": 0.0,
+            "ledgers": [],
+        }
+        serve_dir = os.path.join(app_dir, "serve")
+        if not os.path.isdir(serve_dir):
+            return out
+        for name in sorted(os.listdir(serve_dir)):
+            if not (name.startswith("requests_") and name.endswith(".json")):
+                continue
+            ledger = _read_json(os.path.join(serve_dir, name))
+            if not isinstance(ledger, dict):
+                continue
+            out["ledgers"].append(name)
+            out["rejected"] += int(ledger.get("rejected", 0))
+            out["pending"] += len(ledger.get("pending", []))
+            for entry in ledger.get("requests", []):
+                out["requests"] += 1
+                reason = entry.get("finish_reason", "")
+                if reason in ("eos", "length"):
+                    out["finished"] += 1
+                elif reason in ("rejected", "draining"):
+                    # explicit backpressure — the invariant checker does
+                    # not count these as losses, so neither does the fleet
+                    # view (a clean job must not chart as erroring)
+                    out["rejected"] += 1
+                else:
+                    out["errors"] += 1
+                out["replays"] += int(entry.get("replays", 0))
+                out["ttft_max_s"] = max(
+                    out["ttft_max_s"], float(entry.get("ttft_s", 0.0))
+                )
+        return out
+
+    def serve_summaries(self) -> dict[str, dict]:
+        """Per-app serving rollups for the fleet ``/api/serve`` view
+        (apps without ledgers are omitted — most jobs train)."""
+        out: dict[str, dict] = {}
+        if not os.path.isdir(self.apps_root):
+            return out
+        for app_id in sorted(os.listdir(self.apps_root)):
+            s = self.serve_summary(app_id)
+            if s is not None and s["ledgers"]:
+                out[app_id] = s
+        return out
 
     def health(self, app_id: str) -> dict | None:
         """One app's numerics-health rollup (verdicts + bundle listing,
@@ -406,6 +468,15 @@ def make_handler(data: PortalData):
             if parts[0] == "api":
                 if len(parts) == 2 and parts[1] == "jobs":
                     return self._send(200, json.dumps(data.jobs()), "application/json")
+                if len(parts) == 2 and parts[1] == "serve":
+                    return self._send(
+                        200, json.dumps(data.serve_summaries()), "application/json"
+                    )
+                if len(parts) == 3 and parts[1] == "serve":
+                    s = data.serve_summary(parts[2])
+                    if s is not None:
+                        return self._send(200, json.dumps(s), "application/json")
+                    return self._send(404, "{}", "application/json")
                 if len(parts) == 3 and parts[1] == "job":
                     detail = data.job(parts[2])
                     if detail is not None:
@@ -427,9 +498,24 @@ def make_handler(data: PortalData):
 
 
 def serve_portal(apps_root: str, port: int = 0, host: str = "127.0.0.1"):
-    """Start the portal; returns (server, bound_port). server.serve_forever()."""
-    server = ThreadingHTTPServer((host, port), make_handler(PortalData(apps_root)))
-    return server, server.server_address[1]
+    """Start the portal; returns (server, bound_port). server.serve_forever().
+
+    A configured (non-ephemeral) port goes through the bounded
+    bind-with-retry (utils/net.py): a portal restart racing its
+    predecessor's TIME_WAIT socket retries briefly instead of crashing or
+    silently landing elsewhere.
+    """
+    from tony_tpu.utils.net import bind_with_retry
+
+    handler = make_handler(PortalData(apps_root))
+    servers: list[ThreadingHTTPServer] = []
+
+    def _bind(p: int) -> int:
+        servers.append(ThreadingHTTPServer((host, p), handler))
+        return servers[-1].server_address[1]
+
+    bound = bind_with_retry(_bind, port, attempts=8)
+    return servers[-1], bound
 
 
 def main() -> None:

@@ -1,20 +1,28 @@
-"""Control-plane RPC: protobuf messages + gRPC service/client.
+"""Control-plane + serving-data-plane RPC: protobuf messages, gRPC services.
 
 See tony.proto for the protocol and service.py for the plumbing.
 """
 
 from tony_tpu.rpc import tony_pb2 as pb
 from tony_tpu.rpc.service import (
+    SERVE_SERVICE_NAME,
     SERVICE_NAME,
     ApplicationRpcClient,
     ApplicationRpcServicer,
+    ServeRpcClient,
+    ServeRpcServicer,
     serve,
+    serve_rpc,
 )
 
 __all__ = [
     "ApplicationRpcClient",
     "ApplicationRpcServicer",
+    "SERVE_SERVICE_NAME",
     "SERVICE_NAME",
+    "ServeRpcClient",
+    "ServeRpcServicer",
     "pb",
     "serve",
+    "serve_rpc",
 ]

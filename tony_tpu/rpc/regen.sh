@@ -1,7 +1,13 @@
 #!/bin/sh
 # Regenerate tony_pb2.py from tony.proto. The generated file is committed
-# because the image has protoc but not grpcio-tools; service stubs are
+# because images may ship neither protoc nor grpcio-tools; service stubs are
 # hand-written in service.py.
+#
+# Without protoc, new messages can be appended programmatically instead:
+# parse the serialized FileDescriptorProto out of the committed tony_pb2.py
+# with google.protobuf.descriptor_pb2, add DescriptorProtos for the new
+# messages (keep tony.proto in sync by hand), reserialize, and rewrite the
+# AddSerializedFile blob — the ServeRpc messages were added that way.
 set -e
 cd "$(dirname "$0")"
 protoc --python_out=. tony.proto

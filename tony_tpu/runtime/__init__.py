@@ -6,13 +6,17 @@ from tony_tpu.runtime.frameworks import (
     MLGenericRuntime,
     MXNetRuntime,
     PyTorchRuntime,
+    ServeRuntime,
     TFRuntime,
 )
 from tony_tpu.runtime.jax_tpu import JaxTpuRuntime, in_tony_job, initialize
 
 _RUNTIMES = {
     cls.name: cls
-    for cls in (JaxTpuRuntime, TFRuntime, PyTorchRuntime, HorovodRuntime, MXNetRuntime, MLGenericRuntime)
+    for cls in (
+        JaxTpuRuntime, TFRuntime, PyTorchRuntime, HorovodRuntime,
+        MXNetRuntime, MLGenericRuntime, ServeRuntime,
+    )
 }
 
 
@@ -33,6 +37,7 @@ __all__ = [
     "MXNetRuntime",
     "PyTorchRuntime",
     "Runtime",
+    "ServeRuntime",
     "TFRuntime",
     "TaskIdentity",
     "in_tony_job",

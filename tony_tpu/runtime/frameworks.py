@@ -155,10 +155,49 @@ class MLGenericRuntime(Runtime):
         return True
 
 
+class ServeRuntime(Runtime):
+    """`tony serve` gang workers (serve/gang.py; docs/SERVE.md).
+
+    The serving job type's contract: every decode host LISTENS on the
+    data port the executor reserved and registered (the frontend
+    discovers hosts at exactly those cluster-spec addresses through the
+    AM task table), so the port is exported explicitly as
+    TONY_SERVE_PORT; the ``serve.gang.*`` key group rides along as JSON
+    (TONY_SERVE_GANG) — the AM -> executor -> worker export path every
+    obs.* key group uses — so the worker needs no config-file reparse.
+    """
+
+    name = "serve"
+
+    def validate(self, config: TonyConfig) -> None:
+        from tony_tpu.config.keys import Keys
+
+        gang_type = config.get_str(Keys.SERVE_GANG_JOB_TYPE, "decode")
+        if gang_type not in config.job_types():
+            raise ValueError(
+                f"serve jobs need a [job.{gang_type}] section (or set "
+                "serve.gang.job_type to the decode-host task type)"
+            )
+
+    def needs_data_port(self) -> bool:
+        return True
+
+    def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
+        # import-light on purpose: gang.py defers its engine (and jax)
+        # imports, so the executor process stays a pure control-plane one
+        from tony_tpu.serve.gang import ENV_SERVE_GANG, ENV_SERVE_PORT, GangSettings
+
+        env = super().build_env(identity, config)
+        env[ENV_SERVE_PORT] = identity.own_address.rpartition(":")[2]
+        env[ENV_SERVE_GANG] = GangSettings.from_config(config).to_json()
+        return env
+
+
 __all__ = [
     "HorovodRuntime",
     "MLGenericRuntime",
     "MXNetRuntime",
     "PyTorchRuntime",
+    "ServeRuntime",
     "TFRuntime",
 ]

@@ -1,9 +1,14 @@
-"""Serving: slot-batched continuous decoding (docs/SERVE.md)."""
+"""Serving: slot-batched continuous decoding + multi-host inference gangs
+(docs/SERVE.md). serve.gang / serve.frontend are imported directly by
+their users (`tony serve`, the gang worker entrypoint) — not re-exported
+here — so importing the engine surface stays jax-only."""
 
 from tony_tpu.serve.cache import BlockKVCache, create_cache, grow_cache, shrink_cache
-from tony_tpu.serve.engine import Completion, Engine, Request, ServeConfig
+from tony_tpu.serve.engine import (
+    AdmissionRejected, Completion, Engine, Request, ServeConfig,
+)
 
 __all__ = [
-    "BlockKVCache", "Completion", "Engine", "Request", "ServeConfig",
-    "create_cache", "grow_cache", "shrink_cache",
+    "AdmissionRejected", "BlockKVCache", "Completion", "Engine", "Request",
+    "ServeConfig", "create_cache", "grow_cache", "shrink_cache",
 ]

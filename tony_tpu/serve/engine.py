@@ -84,6 +84,17 @@ class ServeConfig:
     # release cache blocks when the live maximum drops below half the
     # capacity (each capacity change recompiles the decode step once)
     shrink: bool = True
+    # bounded admission: submit() raises AdmissionRejected once this many
+    # requests are queued (0 = unbounded, the pre-gang legacy). This is the
+    # backpressure seam the gang frontend leans on — a host whose queue is
+    # full must say so NOW so the router can pick a survivor, not absorb
+    # work it will serve tail-latency-late. Rejections count into the
+    # tony_serve_rejected_total registry counter.
+    max_queue: int = 0
+
+
+class AdmissionRejected(RuntimeError):
+    """submit() refused: the admission queue is at ServeConfig.max_queue."""
 
 
 @dataclass
@@ -185,6 +196,7 @@ class Engine:
             slots=serve.slots, max_len=max_len, kv_block=serve.kv_block,
             prefill_buckets=buckets, decode_impl=serve.decode_impl,
             max_top_k=serve.max_top_k, shrink=serve.shrink,
+            max_queue=serve.max_queue,
         )
         S = self.serve.slots
         try:
@@ -269,6 +281,14 @@ class Engine:
                 f"prompt {plen} + max_new_tokens {req.max_new_tokens} "
                 f"exceeds max_len {self.serve.max_len}"
             )
+        if self.serve.max_queue and len(self._queue) >= self.serve.max_queue:
+            # an explicit reject, never silent queueing past the bound: the
+            # caller (gang frontend, a driver) owns the backpressure policy
+            self._c_rejected.inc()
+            raise AdmissionRejected(
+                f"admission queue full ({len(self._queue)} >= max_queue "
+                f"{self.serve.max_queue})"
+            )
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append((rid, req))
@@ -286,6 +306,17 @@ class Engine:
     def n_live(self) -> int:
         return sum(1 for r in self._slot_rid if r is not None)
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests admitted but not yet slotted."""
+        return len(self._queue)
+
+    @property
+    def rejected_total(self) -> int:
+        """Submissions refused by bounded admission since the last
+        reset_metrics()."""
+        return int(self._c_rejected.value)
+
     def _init_registry(self) -> None:
         reg = self.registry = Registry()
         self._h_ttft = reg.histogram("tony_ttft_seconds",
@@ -300,6 +331,10 @@ class Engine:
                                      "tokens sampled (prefill + decode)")
         self._c_finished = reg.counter("tony_requests_finished_total",
                                        "requests completed (eos or budget)")
+        self._c_rejected = reg.counter(
+            "tony_serve_rejected_total",
+            "submissions rejected by bounded admission (queue at max_queue)",
+        )
 
     def reset_metrics(self) -> None:
         """Fresh throughput/latency counters (e.g. after a warmup trace
@@ -375,6 +410,19 @@ class Engine:
         if self.n_live:
             self._decode_once()
         return self.n_live
+
+    def completion_of(self, rid: int) -> Completion | None:
+        """Live view of a request's completion: ``tokens`` grows in place
+        as the engine decodes and ``finish_reason`` lands when it ends.
+        The gang worker's streaming seam (serve/gang.py) — callers must
+        not mutate the returned object."""
+        return self._completions.get(rid)
+
+    def take_completion(self, rid: int) -> Completion | None:
+        """Pop one finished completion (the incremental form of what
+        run() does wholesale, so a long-lived streaming driver never
+        accumulates every Completion forever)."""
+        return self._completions.pop(rid, None)
 
     def run(self, requests: Sequence[Request] | None = None) -> dict[int, Completion]:
         """Submit ``requests`` (if given), drain queue and live slots, and
@@ -778,4 +826,6 @@ def _decode_step(params, cache: BlockKVCache, state: _SlotState, *,
 
 
 
-__all__ = ["Completion", "Engine", "Request", "ServeConfig"]
+__all__ = [
+    "AdmissionRejected", "Completion", "Engine", "Request", "ServeConfig",
+]

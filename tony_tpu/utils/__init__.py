@@ -1,6 +1,9 @@
 """Shared utilities (reference: tony-core/.../util/Utils.java, HdfsUtils.java)."""
 
-from tony_tpu.utils.net import find_free_port, local_host
+from tony_tpu.utils.net import bind_with_retry, find_free_port, local_host
 from tony_tpu.utils.proc import LoggedProc, run_logged
 
-__all__ = ["find_free_port", "local_host", "LoggedProc", "run_logged"]
+__all__ = [
+    "bind_with_retry", "find_free_port", "local_host", "LoggedProc",
+    "run_logged",
+]

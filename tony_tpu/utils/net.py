@@ -9,7 +9,12 @@ the coordinator port only.
 
 from __future__ import annotations
 
+import logging
 import socket
+import time
+from typing import Callable
+
+log = logging.getLogger(__name__)
 
 
 def local_host() -> str:
@@ -38,8 +43,51 @@ def find_free_port(host: str = "") -> int:
 
     Racy by construction (the port can be taken between release and reuse);
     callers that can, should bind port 0 themselves and report what they got.
+    When the consumer of the port is a server you control, use
+    :func:`bind_with_retry` instead — it closes the pick-then-bind gap.
     """
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind((host, 0))
         return s.getsockname()[1]
+
+
+def bind_with_retry(
+    bind: Callable[[int], int | None],
+    port: int,
+    *,
+    attempts: int = 8,
+    retry_delay_s: float = 0.25,
+) -> int:
+    """Bounded bind-with-retry: the fix for the find_free_port TOCTOU.
+
+    ``bind(port)`` must attempt the bind and return the bound port (or
+    None / raise OSError on failure; ``port`` 0 means "pick ephemeral").
+    A busy non-ephemeral port is retried up to ``attempts`` times with a
+    short delay — enough for the restart races this actually covers
+    (TIME_WAIT from the previous incarnation of the same listener, a
+    probe socket not yet closed) — and then raises instead of silently
+    serving on a port nobody registered. Ephemeral asks (``port`` 0)
+    retry without the delay: each attempt picks a fresh port, so waiting
+    buys nothing.
+    """
+    attempts = max(1, int(attempts))
+    last_err: OSError | None = None
+    for i in range(attempts):
+        if i:
+            if port:
+                time.sleep(retry_delay_s)
+            log.warning(
+                "bind of port %d failed; retry %d/%d", port, i, attempts - 1
+            )
+        try:
+            bound = bind(port)
+        except OSError as e:
+            last_err = e
+            continue
+        if bound:
+            return bound
+    raise OSError(
+        f"could not bind port {port or '(ephemeral)'} after {attempts} "
+        f"attempt(s)" + (f": {last_err}" if last_err else "")
+    )
