@@ -953,4 +953,12 @@ if __name__ == "__main__":
             "extra": {"error": f"{type(e).__name__}: {e}"},
         }
     print(json.dumps(result))
+    # canonical on-disk artifact for `tony perf diff <old> <new>` (the
+    # cross-run regression gate, obs/perf_diff.py): BENCH_REPORT overrides
+    # the destination; failure to write never fails the bench
+    try:
+        with open(os.environ.get("BENCH_REPORT", "bench_report.json"), "w") as f:
+            json.dump(result, f)
+    except OSError:
+        pass
     sys.exit(0)

@@ -31,7 +31,7 @@ def build() -> str:
             if not text.startswith("---"):  # skip section markers
                 comment.append(text)
             continue
-        m = re.match(r'([A-Z_]+) = "([^"]+)"(?:\s*#\s*(.*))?', line)
+        m = re.match(r'([A-Z0-9_]+) = "([^"]+)"(?:\s*#\s*(.*))?', line)
         if not m:
             if not line:
                 comment = []

@@ -404,6 +404,30 @@ class TestGL005:
         assert all("disarmed" in f.message for f in fs)
         assert sorted(f.line for f in fs) == [9, 11]
 
+    def test_series_sample_seam_holds_the_same_contract(self, tmp_path):
+        """The live-series recorder's sample() seam (obs/series.py) is the
+        fourth observatory hook: the wired call shapes (bare call in the
+        serve/frontend loops, precomputed names in fit()) are clean; an
+        argument that calls or allocates before the armed check fires."""
+        fs = lint_src(tmp_path, {"mod.py": """
+            from tony_tpu.obs import series
+
+            def hot_loop(step, stats):
+                # the wired call shapes: bare call / bare names
+                series.sample()
+                series.sample(step=step)
+                # eager call argument: evaluated even when disarmed — fires
+                series.sample(stats=scrape(stats))
+                # comprehension argument: ditto — fires
+                series.sample(vals=[v for v in stats])
+
+            def scrape(s):
+                return dict(s)
+        """}, select="GL005")
+        assert len(fs) == 2
+        assert all("disarmed" in f.message for f in fs)
+        assert sorted(f.line for f in fs) == [9, 11]
+
 
 # --- suppression / baseline machinery ----------------------------------------
 

@@ -1,0 +1,170 @@
+"""`tony perf diff` (obs/perf_diff.py): the cross-run regression gate.
+
+The committed fixtures under tests/fixtures/perf/ ARE the tier-1 gate:
+the identity diff must stay green, and the regression fixture (tok/s
+down ~22%, decode TTFT p99 up ~3.4x) must stay red — a rule change that
+stops flagging either breaks here, loudly."""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu.obs.perf_diff import (
+    DEFAULT_RULES, diff, diff_files, flatten, load_report, rule_for,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "perf")
+BASE = os.path.join(FIXTURES, "bench_base.json")
+REGRESSED = os.path.join(FIXTURES, "bench_regressed.json")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestFlattenAndRules:
+    def test_flatten_numeric_leaves_only(self):
+        flat = flatten({
+            "a": 1, "b": {"c": 2.5, "d": "s", "e": True, "f": [1, 2]},
+        })
+        assert flat == {"a": 1.0, "b.c": 2.5}  # strings/bools/lists excluded
+
+    def test_rule_directions(self):
+        assert rule_for("extra.tokens_per_sec_per_chip")[0] == "higher"
+        assert rule_for("extra.decode.full_slot.ttft_p99_s")[0] == "lower"
+        assert rule_for("extra.loss")[0] == "lower"
+        assert rule_for("extra.peak_hbm_gb")[0] == "lower"
+        assert rule_for("extra.n_params")[0] == "config"
+        assert rule_for("extra.batch")[0] == "config"
+        assert rule_for("vs_baseline")[0] == "skip"
+        assert rule_for("extra.xla_compiles")[0] == "lower"
+        assert rule_for("extra.gqa_capacity.slots")[0] == "higher"
+        # headroom is higher-better DESPITE carrying 'hbm': a collapse
+        # must flag as a regression, not pass as a memory improvement
+        assert rule_for("decode_0.hbm_headroom_frac")[0] == "higher"
+
+    def test_headroom_collapse_is_a_regression(self):
+        v = diff(
+            {"p": {"hbm_headroom_frac": 0.5}},
+            {"p": {"hbm_headroom_frac": 0.1}},
+        )
+        assert not v["ok"]
+        assert v["regressions"][0]["key"] == "p.hbm_headroom_frac"
+
+
+class TestVerdict:
+    def test_identity_diff_is_green(self):
+        base = load_report(BASE)
+        v = diff(base, base)
+        assert v["ok"] and v["regressions"] == [] and v["compared"] > 5
+        assert v["config_changed"] == []
+
+    def test_regression_fixture_is_red_with_the_right_keys(self):
+        v = diff_files(BASE, REGRESSED)
+        assert not v["ok"]
+        keys = {r["key"] for r in v["regressions"]}
+        assert "extra.tokens_per_sec_per_chip" in keys
+        assert "extra.decode.full_slot.ttft_p99_s" in keys
+        assert "extra.mfu" in keys
+        # within-tolerance drift is NOT flagged
+        assert "extra.loss" not in keys          # +0.04% << 2%
+        assert "extra.peak_hbm_gb" not in keys   # +1.5% << 10%
+        # worst regression leads the report
+        assert v["regressions"][0]["key"] == "extra.decode.full_slot.ttft_p99_s"
+
+    def test_improvements_and_direction(self):
+        base = load_report(BASE)
+        better = json.loads(json.dumps(base))
+        better["extra"]["tokens_per_sec_per_chip"] *= 1.2
+        better["extra"]["decode"]["full_slot"]["ttft_p99_s"] *= 0.5
+        v = diff(base, better)
+        assert v["ok"]
+        keys = {r["key"] for r in v["improvements"]}
+        assert "extra.tokens_per_sec_per_chip" in keys
+        assert "extra.decode.full_slot.ttft_p99_s" in keys
+
+    def test_config_changes_reported_separately(self):
+        base = load_report(BASE)
+        changed = json.loads(json.dumps(base))
+        changed["extra"]["batch"] = 8
+        v = diff(base, changed)
+        assert v["ok"]  # a config change is not a perf regression...
+        assert v["config_changed"] == [
+            {"key": "extra.batch", "old": 4.0, "new": 8.0}
+        ]  # ...but it is never hidden
+
+    def test_compile_count_regression_has_zero_tolerance(self):
+        base = load_report(BASE)
+        worse = json.loads(json.dumps(base))
+        worse["extra"]["xla_compiles"] = 4
+        v = diff(base, worse)
+        assert any(
+            r["key"] == "extra.xla_compiles" for r in v["regressions"]
+        )
+
+    def test_tol_scale_relaxes_the_gate(self):
+        v = diff_files(BASE, REGRESSED, tol_scale=100.0)
+        assert v["ok"]
+
+    def test_unjudged_keys_are_listed_not_dropped(self):
+        v = diff({"weird_quantity": 1.0}, {"weird_quantity": 2.0})
+        assert v["ok"] and v["unjudged"] == ["weird_quantity"]
+
+
+class TestInputShapes:
+    def test_loads_real_driver_bench_wrappers(self):
+        """The committed BENCH_r*.json at the repo root are first-class
+        inputs; the identity diff over the newest one stays green."""
+        path = os.path.join(REPO, "BENCH_r05.json")
+        if not os.path.exists(path):
+            pytest.skip("no BENCH_r05.json in this checkout")
+        report = load_report(path)
+        assert report["metric"] == "llama1.4b_train_tokens_per_sec_per_chip"
+        flat = flatten(report)
+        assert "extra.tokens_per_sec_per_chip" in flat
+        assert diff(report, report)["ok"]
+
+    def test_loads_series_rollups(self, tmp_path):
+        def rollup(ttft):
+            return {
+                "procs": {
+                    "decode_0": {
+                        "points": [
+                            {"ts": i, "ttft_p99_s": ttft, "queue_depth": 2}
+                            for i in range(5)
+                        ],
+                    }
+                }
+            }
+
+        old_p, new_p = tmp_path / "old.json", tmp_path / "new.json"
+        old_p.write_text(json.dumps(rollup(0.1)))
+        new_p.write_text(json.dumps(rollup(0.5)))
+        v = diff_files(str(old_p), str(new_p))
+        assert not v["ok"]
+        assert v["regressions"][0]["key"] == "decode_0.ttft_p99_s"
+
+    def test_unusable_input_is_a_clean_error(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("[1, 2]")
+        with pytest.raises(ValueError):
+            load_report(str(bad))
+
+
+class TestCli:
+    def test_tony_perf_diff_exit_codes(self, tmp_path, capsys):
+        from tony_tpu.cli.main import main
+
+        assert main(["perf", "diff", BASE, BASE]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] is True
+        assert main(["perf", "diff", BASE, REGRESSED]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] is False and out["regressions"]
+        assert main(
+            ["perf", "diff", BASE, str(tmp_path / "missing.json")]
+        ) == 2
+
+    def test_first_rule_match_wins_is_ordered(self):
+        # ordering sanity: the config rule outranks the latency catch-all,
+        # or `steps`-ish keys would be judged as latencies
+        idx = {kind: i for i, (_, kind, _) in enumerate(DEFAULT_RULES)}
+        assert idx["config"] < idx["lower"]

@@ -208,12 +208,13 @@ def test_disarmed_trace_span_is_within_noise_of_noop():
 def test_fit_loop_stays_unblocked_with_tracing_armed(tmp_path):
     """The armed contract: with the trace spine recording at the default
     sampling stride AND the HBM observatory AND the numerics sentinel
-    sampling at their default strides (in-graph value monitors fused into
-    the step, rule engine evaluating async), the tiny-model fit loop must
-    still clear the host-blocked overlap budget — all three hooks are
-    always-on in jobs, so their cost rides inside the same tier-1 guard
-    as the data path."""
-    from tony_tpu.obs import hbm, health, trace
+    AND the live-series recorder sampling at their default strides
+    (in-graph value monitors fused into the step, rule engine and series
+    writer evaluating async), the tiny-model fit loop must still clear
+    the host-blocked overlap budget — all four hooks are always-on in
+    jobs, so their cost rides inside the same tier-1 guard as the data
+    path."""
+    from tony_tpu.obs import hbm, health, series, trace
 
     tracer = trace.install(trace.Tracer(
         str(tmp_path / "trace" / "guard.jsonl"), "guard", "guardtrace",
@@ -230,6 +231,11 @@ def test_fit_loop_stays_unblocked_with_tracing_armed(tmp_path):
     health.install(health.HealthSentinel(
         sample_every=16,  # the obs.health.sample_steps default
     ))
+    series.uninstall()
+    series.install(series.SeriesRecorder(
+        str(tmp_path / "series" / "guard.jsonl"), "guard",
+        sample_every=16,  # the obs.series.sample_steps default
+    ))
     try:
         final = fit(FitConfig(
             model=LlamaConfig.tiny(),
@@ -240,18 +246,29 @@ def test_fit_loop_stays_unblocked_with_tracing_armed(tmp_path):
             lr=5e-3,
             warmup_steps=2,
         ))
+        series.active_recorder().drain()
     finally:
         trace.uninstall()
         hbm.uninstall()
         health.uninstall()
+        series.uninstall()
     assert np.isfinite(final["final_loss"])
     assert final["host_blocked_frac"] < MAX_HOST_BLOCKED_FRAC, (
         f"step loop is {final['host_blocked_frac']:.0%} host-blocked with "
-        "tracing + memory + health sampling armed — a spine is stalling "
-        "the loop"
+        "tracing + memory + health + series sampling armed — a spine is "
+        "stalling the loop"
     )
     # the sentinel evaluated real samples and found a clean run
     assert final["health_verdict"] == "healthy"
+    # the series recorder scraped fit's source into its journal: step
+    # progress plus the built-in HBM reading from the armed (fake) watch
+    from tony_tpu.obs.series import read_series
+
+    points = read_series(str(tmp_path / "series"))["guard"]
+    assert points, "the fit loop never scraped the series"
+    assert points[-1]["step"] == 25          # the shutdown force_sample
+    assert points[-1]["hbm_live_bytes"] == 1 << 30
+    assert any("goodput_frac" in p for p in points)
     # the spine actually recorded: fit root + sampled step spans, and the
     # step-time distribution made it into the final report
     import json
@@ -344,3 +361,45 @@ def test_disarmed_health_sample_is_within_noise_of_noop():
         assert sentinel is health.active_sentinel()
     finally:
         health.uninstall()
+
+
+def test_disarmed_series_sample_is_within_noise_of_noop():
+    """The live-series recorder's no-op contract (the fourth twin): a
+    sample() call with no recorder armed is one global load + None
+    compare — cheap enough to sit in the train/serve step loops
+    unconditionally. graft-lint GL005 holds the call-site side of the
+    same contract (tests/test_lint.py has the series fixtures)."""
+    import time
+
+    from tony_tpu.obs import series
+
+    series.uninstall()  # other tests/fit runs may have armed the process
+    N = 50_000
+    for _ in range(1000):
+        series.sample()
+    per_call = math.inf
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(N):
+            series.sample()
+        per_call = min(per_call, (time.perf_counter() - t0) / N)
+    assert per_call < 5e-6, (
+        f"disarmed series.sample costs {per_call * 1e9:.0f}ns/call — the "
+        "no-op path regressed (is something arming a recorder or "
+        "allocating?)"
+    )
+    # armed-but-off-stride: one counter bump, no source is ever scraped
+    calls = []
+    rec = series.install(series.SeriesRecorder(
+        None, "guard", sample_every=1000,
+    ))
+    rec.attach("probe", lambda: calls.append(1) or {"v": 1.0})
+    try:
+        for _ in range(999):
+            series.sample()
+        assert calls == []  # sources never scraped off-stride
+        series.sample()
+        assert len(calls) == 1
+        assert rec is series.active_recorder()
+    finally:
+        series.uninstall()

@@ -1,0 +1,311 @@
+"""SLO engine (obs/slo.py): burn-rate windowing edge cases, the latch +
+flushed-instant contract, the chaos `slo-surfaced` invariant, and the
+end-to-end serve-engine trip."""
+
+import json
+import os
+import time
+
+from tony_tpu.obs import series, slo, trace
+from tony_tpu.obs.slo import SloConfig, SloEngine
+
+
+def _engine(tmp_path=None, **cfg):
+    cfg.setdefault("ttft_p99_s", 0.5)
+    cfg.setdefault("fast_window_s", 60.0)
+    cfg.setdefault("slow_window_s", 3600.0)
+    cfg.setdefault("min_points", 3)
+    return SloEngine(
+        SloConfig(**cfg),
+        app_dir=str(tmp_path) if tmp_path is not None else "",
+        proc="t0",
+    )
+
+
+def _feed(eng, values, key="ttft_p99_s", t0=None, dt=1.0):
+    t0 = time.time() if t0 is None else t0
+    for i, v in enumerate(values):
+        eng.observe({"ts": t0 + i * dt, key: v})
+
+
+class TestConfig:
+    def test_roundtrip_and_active(self):
+        cfg = SloConfig(ttft_p99_s=0.5, goodput_floor=0.8)
+        again = SloConfig.from_json(cfg.to_json())
+        assert again == cfg
+        assert sorted(cfg.active()) == ["goodput_floor", "ttft_p99_s"]
+        assert SloConfig().active() == []  # nothing contracted by default
+
+    def test_from_config_reads_slo_keys(self):
+        from tony_tpu.config.config import TonyConfig
+        from tony_tpu.config.keys import Keys
+
+        c = TonyConfig()
+        c.set(Keys.SLO_TTFT_P99_S, 0.25)
+        c.set(Keys.SLO_FAST_WINDOW_S, 30)
+        cfg = SloConfig.from_config(c)
+        assert cfg.ttft_p99_s == 0.25 and cfg.fast_window_s == 30.0
+        assert cfg.budget_frac == 0.1  # defaults ride along
+
+    def test_attach_from_env(self, tmp_path, monkeypatch):
+        slo.uninstall()
+        rec = series.SeriesRecorder(None, "t")
+        # no env: nothing armed
+        monkeypatch.delenv(slo.ENV_SLO, raising=False)
+        assert slo.attach_from_env(rec) is None
+        # inactive targets: nothing armed
+        monkeypatch.setenv(slo.ENV_SLO, SloConfig().to_json())
+        assert slo.attach_from_env(rec) is None
+        # active target: engine rides the recorder as an observer
+        monkeypatch.setenv(
+            slo.ENV_SLO,
+            SloConfig(ttft_p99_s=0.001, min_points=1).to_json(),
+        )
+        eng = slo.attach_from_env(rec)
+        try:
+            assert eng is slo.active_engine()
+            rec.force_sample(ttft_p99_s=5.0)
+            rec.drain()
+            assert eng.trip_counts()  # the observer really evaluates
+        finally:
+            slo.uninstall()
+            rec.close()
+
+
+class TestWindowing:
+    def test_empty_series_never_trips(self, tmp_path):
+        eng = _engine(tmp_path)
+        assert eng.verdict == "met"
+        # points without the watched metric are no-data, not violations
+        _feed(eng, [None] * 5, key="unrelated")
+        assert eng.verdict == "met"
+
+    def test_single_sample_window_is_a_blip_not_a_page(self, tmp_path):
+        eng = _engine(tmp_path)
+        _feed(eng, [9.9])  # violently bad, but one sample
+        assert eng.verdict == "met"
+        _feed(eng, [9.9], t0=time.time() + 1)
+        assert eng.verdict == "met"  # still under min_points=3
+
+    def test_trips_within_one_fast_window_and_reports_burn(self, tmp_path):
+        eng = _engine(tmp_path)
+        t0 = time.time()
+        _feed(eng, [2.0, 2.0, 2.0], t0=t0)  # 3 bad points over 2s << 60s
+        assert eng.verdict == "tripped"
+        detail = eng.summary()["detail"]["ttft_p99_s"]
+        assert detail["fast_bad_frac"] == 1.0
+        assert detail["burn_fast"] == 10.0  # 1.0 bad / 0.1 budget
+        assert detail["worst"] == 2.0
+        assert detail["fast_points"] == 3
+
+    def test_under_budget_never_trips(self, tmp_path):
+        eng = _engine(tmp_path, budget_frac=0.5)
+        # 1/4 bad: under the 50% budget in both windows
+        _feed(eng, [0.1, 0.1, 9.0, 0.1])
+        assert eng.verdict == "met"
+
+    def test_below_direction_goodput_floor(self, tmp_path):
+        eng = _engine(tmp_path, ttft_p99_s=0.0, goodput_floor=0.8)
+        _feed(eng, [0.95, 0.9, 0.93], key="goodput_frac")
+        assert eng.verdict == "met"
+        _feed(eng, [0.2, 0.3, 0.25], key="goodput_frac",
+              t0=time.time() + 10)
+        assert eng.verdict == "tripped"
+        assert "goodput_floor" in eng.trip_counts()
+
+    def test_clock_skewed_out_of_order_points_window_consistently(self, tmp_path):
+        """Two hosts' journals merged with skewed clocks: points arrive
+        out of ts order. The engine windows off the newest ts seen and
+        must neither crash nor evict the live window."""
+        eng = _engine(tmp_path, min_points=3, fast_window_s=300.0)
+        t0 = time.time()
+        eng.observe({"ts": t0 + 120, "ttft_p99_s": 2.0})   # host A, fast clock
+        eng.observe({"ts": t0, "ttft_p99_s": 2.0})         # host B, behind
+        eng.observe({"ts": t0 + 1, "ttft_p99_s": 2.0})
+        eng.observe({"ts": t0 + 121, "ttft_p99_s": 2.0})
+        assert eng.verdict == "tripped"
+        # a skew WIDER than the fast window correctly keeps the behind
+        # host's points out of the fast count (no cross-clock blending)
+        eng_narrow = _engine(tmp_path, min_points=3, fast_window_s=60.0)
+        eng_narrow.observe({"ts": t0 + 120, "ttft_p99_s": 2.0})
+        eng_narrow.observe({"ts": t0, "ttft_p99_s": 2.0})
+        eng_narrow.observe({"ts": t0 + 1, "ttft_p99_s": 2.0})
+        eng_narrow.observe({"ts": t0 + 121, "ttft_p99_s": 2.0})
+        assert eng_narrow.verdict == "met"
+        # ancient stragglers beyond the slow window are evicted, not kept
+        eng2 = _engine(tmp_path, slow_window_s=100.0)
+        eng2.observe({"ts": t0, "ttft_p99_s": 0.1})
+        eng2.observe({"ts": t0 + 500, "ttft_p99_s": 0.1})
+        assert len(eng2._points) == 1  # the old point aged out
+
+    def test_latch_one_bundle_counted_repeats(self, tmp_path):
+        eng = _engine(tmp_path)
+        _feed(eng, [2.0] * 3)
+        assert eng.trip_counts()["ttft_p99_s"] == 1
+        _feed(eng, [2.0] * 4, t0=time.time() + 5)
+        assert eng.trip_counts()["ttft_p99_s"] == 5  # repeats counted...
+        bundles = slo.forensics_files(str(tmp_path))
+        assert bundles == ["t0_ttft_p99_s.trip.json"]  # ...one bundle
+
+
+class TestTripSurfaces:
+    def test_trip_writes_verdict_bundle_metrics_and_flushed_instant(
+        self, tmp_path
+    ):
+        """The latch must survive a chaos SIGKILL: the slo.<name> trace
+        instant is ON DISK the moment _trip returns — no close(), no
+        flusher-thread grace."""
+        from tony_tpu.obs.registry import Registry
+
+        trace.uninstall()
+        tracer = trace.install(trace.Tracer(
+            str(tmp_path / "trace" / "t0.jsonl"), "t0", "tr",
+            flush_interval_s=3600.0,  # the daemon flusher will NOT help
+        ))
+        reg = Registry()
+        eng = SloEngine(
+            SloConfig(ttft_p99_s=0.5, min_points=3),
+            app_dir=str(tmp_path), proc="t0", registry=reg,
+        )
+        try:
+            _feed(eng, [2.0, 2.0, 2.0])
+            assert eng.verdict == "tripped"
+            # instant already journaled (flushed at trip, pre-kill)
+            recs = [
+                json.loads(l)
+                for l in open(tmp_path / "trace" / "t0.jsonl")
+                if l.strip()
+            ]
+            instants = [r for r in recs if r.get("ph") == "i"]
+            assert any(r["name"] == "slo.ttft_p99_s" for r in instants)
+        finally:
+            trace.uninstall()
+        # verdict + bundle on disk
+        verdicts = slo.read_verdicts(str(tmp_path))
+        assert verdicts["t0"]["verdict"] == "tripped"
+        assert "ttft_p99_s" in verdicts["t0"]["slos"]
+        bundle_path = tmp_path / "slo" / "t0_ttft_p99_s.trip.json"
+        bundle = json.loads(bundle_path.read_text())
+        assert bundle["detail"]["worst"] == 2.0
+        assert bundle["window"]  # the series slice at trip rode along
+        # registry metrics
+        snap = {
+            (e["name"], tuple(sorted(e["labels"].items()))): e
+            for e in reg.snapshot()
+        }
+        assert snap[("tony_slo_verdict", ())]["value"] == 1.0
+        assert snap[
+            ("tony_slo_trips_total", (("slo", "ttft_p99_s"),))
+        ]["value"] >= 1
+        # rollup verdict
+        assert slo.rollup(str(tmp_path))["verdict"] == "tripped"
+
+    def test_met_verdict_is_recorded_distinguishably(self, tmp_path):
+        eng = _engine(tmp_path)
+        eng.write_verdict()
+        roll = slo.rollup(str(tmp_path))
+        assert roll["verdict"] == "met"
+        assert slo.rollup(str(tmp_path / "nothing"))["verdict"] == "unwatched"
+
+
+class TestChaosInvariant:
+    def _job(self, tmp_path, name, slo_verdict):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "status.json").write_text(
+            json.dumps({"state": "SUCCEEDED", "exit_code": 0, "tasks": []})
+        )
+        ev = d / "events"
+        ev.mkdir()
+        (ev / f"{name}.jhist.jsonl").write_text(json.dumps(
+            {"type": "APPLICATION_FINISHED", "ts": 0, "state": "SUCCEEDED"}
+        ) + "\n")
+        if slo_verdict:
+            sdir = d / "slo"
+            sdir.mkdir()
+            (sdir / "verdict_w0.json").write_text(json.dumps(slo_verdict))
+        return str(d)
+
+    def test_tripped_slo_can_never_report_clean(self, tmp_path):
+        from tony_tpu.chaos.invariants import check_invariants
+
+        clean = self._job(tmp_path, "clean", {
+            "verdict": "met", "proc": "w0", "slos": {},
+        })
+        assert check_invariants([clean]).ok
+        bad = self._job(tmp_path, "bad", {
+            "verdict": "tripped", "proc": "w0",
+            "slos": {"ttft_p99_s": {"trips": 9}},
+        })
+        report = check_invariants([bad])
+        assert not report.ok
+        v = [x for x in report.violations if x.invariant == "slo-surfaced"]
+        assert len(v) == 1
+        assert "ttft_p99_s" in v[0].detail and "w0" in v[0].detail
+
+
+class TestEndToEnd:
+    def test_serve_engine_trips_ttft_slo_within_one_fast_window(
+        self, tmp_path, monkeypatch
+    ):
+        """The acceptance shape in-process: a decode engine whose real
+        TTFT violates a (deliberately impossible) ttft_p99_s contract
+        trips the SLO within one fast window of serving; the verdict,
+        bundle, series journal, and `tony top` frame all agree."""
+        import jax
+
+        from tony_tpu.models.llama import LlamaConfig, init_params
+        from tony_tpu.obs.top import build_view, render
+        from tony_tpu.serve.engine import Engine, Request, ServeConfig
+
+        app_dir = tmp_path / "app-e2e"
+        app_dir.mkdir()
+        monkeypatch.setenv("TONY_APP_DIR", str(app_dir))
+        monkeypatch.setenv("TONY_TRACE_PROC", "decode_0_user")
+        monkeypatch.setenv(series.ENV_SAMPLE, "1")  # scrape every step
+        monkeypatch.setenv(slo.ENV_SLO, SloConfig(
+            ttft_p99_s=1e-6,       # no real prefill can meet this
+            fast_window_s=30.0,    # one fast window bounds the whole run
+            min_points=3,
+        ).to_json())
+        series.uninstall()
+        slo.uninstall()
+        try:
+            cfg = LlamaConfig.tiny()
+            # slots=1 + tiny budgets: every request is its own admission
+            # wave, so ttft deltas land on many scrape points
+            eng = Engine(
+                init_params(jax.random.key(0), cfg), cfg,
+                ServeConfig(slots=1, max_len=64),
+            )
+            t0 = time.time()
+            eng.run([
+                Request(prompt=[1, 2, 3], max_new_tokens=2)
+                for _ in range(5)
+            ])
+            summary = eng.close()
+            assert time.time() - t0 < 30.0, "run outgrew the fast window"
+            assert summary["slo_verdict"] == "tripped"
+            assert "ttft_p99_s" in summary["slo_trips"]
+            engine = slo.active_engine()
+            assert engine is not None and engine.verdict == "tripped"
+        finally:
+            series.uninstall()
+            slo.uninstall()
+        # verdict + bundle under the app dir
+        verdicts = slo.read_verdicts(str(app_dir))
+        assert any(v["verdict"] == "tripped" for v in verdicts.values())
+        assert slo.forensics_files(str(app_dir))
+        # series journaled under the app dir
+        procs = series.read_series(str(app_dir / "series"))
+        assert "decode_0_user" in procs
+        # and `tony top` renders the tripped run from those artifacts
+        view = build_view(str(app_dir))
+        assert view["slo"]["verdict"] == "tripped"
+        row = next(
+            r for r in view["rows"] if r["proc"] == "decode_0_user"
+        )
+        assert row["slo"].startswith("TRIP:")
+        assert "ttft_p99_s" in row["slo"]
+        frame = render(view)
+        assert "TRIP:ttft_p99_s" in frame

@@ -27,7 +27,7 @@ from typing import Any
 
 from tony_tpu.am.events import EventType, EventWriter
 from tony_tpu.chaos import chaos_hook
-from tony_tpu.obs import hbm, health, trace
+from tony_tpu.obs import hbm, health, series, slo, trace
 from tony_tpu.am.scheduler import SchedulerHooks, TaskScheduler
 from tony_tpu.am.session import JobState, Session, TaskState, TERMINAL
 from tony_tpu.cluster import make_backend
@@ -110,6 +110,18 @@ class ApplicationMaster(ApplicationRpcServicer):
         self._last_metrics_event: dict[str, float] = {}
         self._step_metric_seen: set[str] = set()
         self._metrics_event_min_interval_s = 30.0
+        # per-task series scraped off the PushMetrics heartbeat-path RPC:
+        # a bounded recent window per task plus the wall time it arrived,
+        # rolled up into <app_dir>/series/am_rollup.json (throttled) —
+        # the fleet view `tony top` and the portal /api/series serve even
+        # when workers run on hosts whose journals the AM cannot read
+        self._series_history: dict[str, Any] = {}
+        self._series_push_ts: dict[str, float] = {}
+        self._last_series_rollup = 0.0
+        self._series_rollup_min_interval_s = 5.0
+        # guards the two dicts above against concurrent PushMetrics
+        # handler threads; held for dict ops only, NEVER across file I/O
+        self._series_lock = threading.Lock()
         self._scheduler_mode = config.get_str(Keys.SCHEDULER_MODE, "GANG").upper()
         # serializes am.state.json writes (scheduler + supervise threads)
         self._am_state_write_lock = threading.Lock()
@@ -177,6 +189,22 @@ class ApplicationMaster(ApplicationRpcServicer):
         env[health.ENV_WINDOW] = str(
             self.config.get_int(Keys.OBS_HEALTH_WINDOW, 64)
         )
+        # live-series contract (obs/series.py): the worker journals
+        # stride-scraped points under <app_dir>/series/; the AM also
+        # aggregates the metrics pushes it already receives (PushMetrics)
+        # into the app-level rollup `tony top` and /api/series read
+        env[series.ENV_ENABLED] = (
+            "1" if self.config.get_bool(Keys.OBS_SERIES_ENABLED, True) else "0"
+        )
+        env[series.ENV_SAMPLE] = str(
+            self.config.get_int(Keys.OBS_SERIES_SAMPLE_STEPS, 16)
+        )
+        env[series.ENV_JOURNAL_MB] = str(
+            self.config.get_int(Keys.OBS_SERIES_JOURNAL_MB, 16)
+        )
+        # SLO contract (obs/slo.py): the resolved slo.* group as one JSON
+        # blob; workers arm a burn-rate engine only when targets are active
+        env[slo.ENV_SLO] = slo.SloConfig.from_config(self.config).to_json()
         log_path = os.path.join(
             self.app_dir, "logs", f"{spec.name}_{index}_attempt{attempt}.log"
         )
@@ -288,6 +316,7 @@ class ApplicationMaster(ApplicationRpcServicer):
         tid = f"{request.job_name}:{request.index}"
         samples = {s.name: s.value for s in request.samples}
         self._latest_metrics[tid] = samples
+        self._record_series(tid, samples)
         # feed the history pipeline so the portal can chart them (the
         # reference embeds utilization in its avro events the same way).
         # samples nest under their own key (names are user-chosen and must
@@ -309,6 +338,62 @@ class ApplicationMaster(ApplicationRpcServicer):
             self._last_metrics_event[tid] = now
             self.events.emit(EventType.METRICS, task=tid, samples=samples)
         return pb.Empty()
+
+    def _record_series(self, tid: str, samples: dict[str, float]) -> None:
+        """Fleet series aggregation off the existing metrics RPC: keep a
+        bounded recent window per task and write the app-level rollup
+        (throttled; best-effort — a full disk costs the rollup file, not
+        the RPC). Runs on the RPC handler thread; the dict/list ops are
+        cheap and the file write is throttled to one per interval."""
+        ts = time.time()
+        with self._series_lock:
+            window = self._series_history.setdefault(tid, [])
+            window.append({"ts": ts, **samples})
+            if len(window) > 360:
+                del window[: len(window) - 360]
+            self._series_push_ts[tid] = ts
+            now = time.monotonic()
+            if (now - self._last_series_rollup
+                    < self._series_rollup_min_interval_s):
+                return
+            self._last_series_rollup = now
+        self._write_series_rollup()
+
+    def _write_series_rollup(self) -> None:
+        """Atomic ``<app_dir>/series/am_rollup.json``: per-task point
+        windows with explicit staleness (age since the last push) — a
+        dead host's frozen numbers must read as stale, never current.
+        The payload snapshots under the series lock (pure dict copies);
+        the file write happens outside it."""
+        now = time.time()
+        with self._series_lock:
+            payload = {
+                "ts": now,
+                "tasks": {
+                    tid: {
+                        "last_ts": self._series_push_ts.get(tid, 0.0),
+                        "age_s": round(
+                            max(now - self._series_push_ts.get(tid, 0.0), 0.0),
+                            1,
+                        ),
+                        "points": list(window)[-120:],
+                    }
+                    for tid, window in sorted(self._series_history.items())
+                },
+            }
+        out_dir = os.path.join(self.app_dir, "series")
+        path = os.path.join(out_dir, "am_rollup.json")
+        # two RPC handler threads can race past the throttle: a unique tmp
+        # name + atomic replace keeps the visible file whole without
+        # holding any lock across file I/O (GL004 discipline)
+        tmp = f"{path}.tmp{threading.get_native_id()}"
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            log.debug("could not write series rollup", exc_info=True)
 
     # --- RPC handlers (client-facing) ----------------------------------------
 

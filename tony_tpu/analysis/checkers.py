@@ -710,11 +710,12 @@ class DisarmedHookCost:
             # object obtained after the armed check are fine
             return len(parts) == 1 or parts[-2] in ("trace", "chaos")
         if parts[-1] == "sample" and len(parts) >= 2 and parts[-2] in (
-            "hbm", "health"
+            "hbm", "health", "series"
         ):
-            # the HBM observatory's and the numerics sentinel's hot-path
-            # seams (obs/hbm.py, obs/health.py): same disarmed-cost
-            # contract as the trace/chaos hooks
+            # the HBM observatory's, the numerics sentinel's, and the
+            # series recorder's hot-path seams (obs/hbm.py, obs/health.py,
+            # obs/series.py): same disarmed-cost contract as the
+            # trace/chaos hooks
             return True
         return False
 
@@ -743,7 +744,8 @@ class DisarmedHookCost:
             # hook *implementation* modules are exempt: the seam body runs
             # after its own armed check by construction
             if mi.modname.endswith(
-                ("obs.trace", "obs.hbm", "obs.health", "chaos.faults")
+                ("obs.trace", "obs.hbm", "obs.health", "obs.series",
+                 "chaos.faults")
             ):
                 continue
             for fi in mi.funcs.values():

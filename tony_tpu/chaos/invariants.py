@@ -43,6 +43,14 @@ the guarantees the module docstrings promise in prose:
     both cases the post-mortem surfaces it as a violation, never swallows
     it into an all-clear.
 
+``slo-surfaced``
+    A job whose SLO engine tripped (obs/slo.py wrote a ``tripped``
+    verdict under ``<app_dir>/slo/``) must not report clean: a burned
+    error budget — TTFT collapse under a partitioned host, goodput below
+    floor through a restart — is the contract violation the chaos run
+    exists to surface, and the post-mortem must say so even when the job
+    itself SUCCEEDED.
+
 ``serve-no-request-lost``
     Over every serving ledger the gang frontend left under
     ``<app_dir>/serve/`` (docs/SERVE.md "Gang serving"): every ACCEPTED
@@ -73,6 +81,7 @@ from typing import Any
 from tony_tpu.am.events import EventType, read_history
 from tony_tpu.cluster.lease import STATE_FILE, _pid_alive, _this_host
 from tony_tpu.obs.health import read_verdicts
+from tony_tpu.obs.slo import read_verdicts as read_slo_verdicts
 
 TERMINAL_STATES = ("SUCCEEDED", "FAILED", "KILLED")
 
@@ -214,6 +223,26 @@ def _check_job(app_dir: str, report: InvariantReport) -> tuple[str, str]:
                 "health-verdict-surfaced", app_id,
                 f"{what} (rules: {', '.join(rules)}; procs: "
                 f"{', '.join(sorted(tripped))})",
+            )
+        )
+    # a tripped SLO verdict is the same class of evidence as a tripped
+    # numerics verdict: the run burned its error budget, and a clean
+    # post-mortem would bury exactly the contract the SLO declares
+    tripped_slo = {
+        proc: v for proc, v in read_slo_verdicts(app_dir).items()
+        if v.get("verdict") == "tripped"
+    }
+    if tripped_slo:
+        names = sorted({
+            name for v in tripped_slo.values() for name in (v.get("slos") or {})
+        })
+        report.violations.append(
+            Violation(
+                "slo-surfaced", app_id,
+                f"job ended {state or 'without status'} with tripped SLO(s) "
+                f"{', '.join(names)} (procs: {', '.join(sorted(tripped_slo))})"
+                " — the burn-rate verdict must reach the post-mortem, never "
+                "an all-clear",
             )
         )
     _check_serve_ledgers(app_dir, app_id, report)

@@ -376,6 +376,41 @@ def cmd_health(args: argparse.Namespace) -> int:
     return 0 if roll["verdict"] == "healthy" else 1
 
 
+def cmd_top(args: argparse.Namespace) -> int:
+    """Live terminal view of one application (docs/OBS.md "SLO + time
+    series"): per-host rows off the series journals + AM rollup,
+    TTFT/queue-depth sparklines, straggler flags, SLO/health columns."""
+    from tony_tpu.obs.top import run_top
+
+    app_dir = resolve_app_dir(args.app)
+    try:
+        return run_top(app_dir, once=args.once, interval_s=args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_perf(args: argparse.Namespace) -> int:
+    """``tony perf diff <old> <new>``: compare two bench reports (or two
+    series rollups) under per-section tolerance rules and emit a
+    regression verdict. Exit 0 = no regression, 1 = regression(s), 2 =
+    unusable input. tests/test_perf_diff.py holds this as a tier-1 gate
+    against committed fixtures."""
+    from tony_tpu.obs.perf_diff import diff_files
+
+    try:
+        verdict = diff_files(args.old, args.new, tol_scale=args.tol_scale)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"cannot diff: {e}", file=sys.stderr)
+        return 2
+    if not args.full:
+        # the printed verdict leads with the judgement; the full key dump
+        # stays behind --full so a green diff is one screen
+        for k in ("unjudged", "only_old", "only_new"):
+            verdict[k] = len(verdict[k])
+    print(json.dumps(verdict, indent=2, sort_keys=True))
+    return 0 if verdict["ok"] else 1
+
+
 def cmd_lint(args: argparse.Namespace) -> int:
     """graft-lint: JAX-aware + concurrency-aware static analysis over the
     given paths (docs/ANALYSIS.md). Exit 0 = no non-baselined findings."""
@@ -538,6 +573,40 @@ def build_parser() -> argparse.ArgumentParser:
         help="inline the forensics bundle contents into the report",
     )
     s.set_defaults(fn=cmd_health)
+
+    s = sub.add_parser(
+        "top",
+        help="live per-host view of an app: series sparklines, straggler "
+             "flags, SLO/health columns (Ctrl-C exits)",
+    )
+    s.add_argument("app", help="application id or app-dir path")
+    s.add_argument("--once", action="store_true",
+                   help="print one frame and exit (scripts, tests)")
+    s.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds")
+    s.set_defaults(fn=cmd_top)
+
+    s = sub.add_parser(
+        "perf",
+        help="performance tooling: `perf diff <old> <new>` compares two "
+             "bench reports / series rollups and exits 1 on regression",
+    )
+    psub = s.add_subparsers(dest="perf_command", required=True)
+    d = psub.add_parser(
+        "diff", help="regression verdict between two reports"
+    )
+    d.add_argument("old", help="baseline report (BENCH_r*.json, bench.py "
+                              "output, or a series rollup)")
+    d.add_argument("new", help="candidate report (same shapes)")
+    d.add_argument(
+        "--tol-scale", type=float, default=1.0,
+        help="scale every rule's relative tolerance (noisy rigs > 1.0)",
+    )
+    d.add_argument(
+        "--full", action="store_true",
+        help="include the unjudged/one-sided key lists verbatim",
+    )
+    d.set_defaults(fn=cmd_perf)
 
     s = sub.add_parser(
         "lint",

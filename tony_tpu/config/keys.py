@@ -94,6 +94,38 @@ class Keys:
     # rolling-statistics window (loss-spike z-score, stagnation) — also
     # the last-k step-stats ring a forensics bundle carries
     OBS_HEALTH_WINDOW = "obs.health.window_steps"
+    # live time-series recorder (obs/series.py; docs/OBS.md "SLO + time
+    # series"): stride-scraped per-process points (step time, TTFT/TPOT
+    # quantiles, queue depth, HBM live/peak, health verdict, goodput)
+    # journaled to ring-rotated series/<proc>.jsonl — the feed `tony top`
+    # renders and the SLO engine alerts on
+    OBS_SERIES_ENABLED = "obs.series.enabled"
+    # scrape every Nth train/serve step (off-stride seam calls are one
+    # increment + compare; the disarmed seam is one global load)
+    OBS_SERIES_SAMPLE_STEPS = "obs.series.sample_steps"
+    # per-process journal rotation size (newest window kept, <= 2x on disk)
+    OBS_SERIES_JOURNAL_MB = "obs.series.max_journal_mb"
+
+    # --- SLOs (obs/slo.py; docs/OBS.md "SLO + time series") ---
+    # declared targets, evaluated as multi-window burn rates over the live
+    # series; 0 = not contracted. A trip latches, emits an slo.<name>
+    # trace instant + tony_slo_* metrics, and writes a verdict + forensics
+    # bundle under <app_dir>/slo/ (the chaos invariant checker's
+    # slo-surfaced rule refuses to report a tripped run clean)
+    SLO_TTFT_P99_S = "slo.ttft_p99_s"
+    SLO_STEP_TIME_P99_S = "slo.step_time_p99_s"
+    SLO_GOODPUT_FLOOR = "slo.goodput_floor"
+    SLO_HBM_HEADROOM_FRAC = "slo.hbm_headroom_frac"
+    SLO_ERROR_RATE = "slo.error_rate"
+    # error budget: the bad-point fraction a window may carry before the
+    # burn rate (bad_frac / budget) exceeds 1 and the SLO trips
+    SLO_BUDGET_FRAC = "slo.budget_frac"
+    # SRE-style multi-window gates: the fast window catches the incident
+    # now, the slow one (clipped to recorded data) proves it is sustained
+    SLO_FAST_WINDOW_S = "slo.fast_window_s"
+    SLO_SLOW_WINDOW_S = "slo.slow_window_s"
+    # minimum fast-window samples before an SLO may trip (blip guard)
+    SLO_MIN_POINTS = "slo.min_points"
 
     # --- gang serving (`tony serve`; serve/gang.py + serve/frontend.py) ---
     # decode-host containers the AM gang-schedules (the serve job's size)
@@ -250,6 +282,18 @@ DEFAULTS: dict[str, object] = {
     Keys.OBS_HEALTH_ENABLED: True,
     Keys.OBS_HEALTH_SAMPLE_STEPS: 16,
     Keys.OBS_HEALTH_WINDOW: 64,
+    Keys.OBS_SERIES_ENABLED: True,
+    Keys.OBS_SERIES_SAMPLE_STEPS: 16,
+    Keys.OBS_SERIES_JOURNAL_MB: 16,
+    Keys.SLO_TTFT_P99_S: 0,
+    Keys.SLO_STEP_TIME_P99_S: 0,
+    Keys.SLO_GOODPUT_FLOOR: 0,
+    Keys.SLO_HBM_HEADROOM_FRAC: 0,
+    Keys.SLO_ERROR_RATE: 0,
+    Keys.SLO_BUDGET_FRAC: 0.1,
+    Keys.SLO_FAST_WINDOW_S: 300,
+    Keys.SLO_SLOW_WINDOW_S: 3600,
+    Keys.SLO_MIN_POINTS: 3,
     Keys.SERVE_GANG_HOSTS: 2,
     Keys.SERVE_GANG_JOB_TYPE: "decode",
     Keys.SERVE_GANG_MODEL: "tiny",

@@ -1,0 +1,212 @@
+"""``tony perf diff``: cross-run performance regression verdicts.
+
+The repo accumulates one BENCH json per merged PR (BENCH_r01..r05 at the
+root) — and until now no tool read them: a PR that tanked tok/s/chip or
+TTFT would sail through review unless a human eyeballed two json blobs.
+This module compares two bench reports (or two live-series rollups) key
+by key under per-section tolerance rules and emits a machine-checkable
+verdict; ``tests/test_perf_diff.py`` wires it as a tier-1 gate against
+committed fixtures, so the gate itself cannot rot.
+
+Inputs it understands (auto-detected):
+
+- a driver **BENCH_r*.json wrapper** (``{"parsed": ..., "tail": "...",
+  ...}``) — the embedded bench-report JSON line is extracted from the
+  tail;
+- a raw **bench report** (bench.py stdout: ``{"metric", "value",
+  "extra": {...}}``);
+- a **series rollup** (obs/series.fleet_rollup or the portal
+  ``/api/series/<app>`` payload) — each proc's numeric keys reduce to the
+  median over its recorded points.
+
+Rules: every numeric key flattens to a dotted path and is matched against
+an ordered pattern list declaring *direction* (is bigger better?) and a
+relative tolerance. Keys matching a ``config`` rule (batch sizes, param
+counts, steps) are compared for *identity* — a changed config is reported
+separately, never as a perf regression. Keys no rule claims are listed as
+``unjudged`` rather than silently dropped: the diff never pretends to
+have covered what it cannot judge.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import statistics
+from typing import Any
+
+# (pattern, kind, rel_tol) — FIRST match wins, so configs and exclusions
+# outrank the broad latency catch-alls below them. kind: "higher" =
+# bigger is better, "lower" = smaller is better, "config" = must match
+# exactly, "skip" = meta/noise, never compared.
+DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
+    # meta / driver plumbing
+    (r"(^|\.)(n|rc|ts|vs_baseline|every|count|step|steps)$", "skip", 0.0),
+    (r"(^|\.)(at|last_ts|age_s|_n)$", "skip", 0.0),
+    (r"_n$", "skip", 0.0),
+    # configuration identity (not performance)
+    (r"(n_params|n_active_params|batch|seq|vocab|n_layers|n_heads|"
+     r"capacity_factor|top_k|slots_formula|kv_block|window)", "config", 0.0),
+    # quality: loss/perplexity may not silently regress either
+    (r"(loss|perplexity)", "lower", 0.02),
+    # throughput-shaped (and headroom: MORE free HBM is better — this
+    # must outrank the broad memory rule below or a headroom collapse
+    # would be judged as a memory improvement): higher is better
+    (r"(tokens_per_sec|tok_s|tflops|mfu|goodput|headroom|occupancy|"
+     r"slots$|requests_per_s|steps_per_s)", "higher", 0.05),
+    # memory: lower is better, generous tolerance (allocator noise)
+    (r"(hbm|bytes|_gb$|_mb$|rss)", "lower", 0.10),
+    # compile counts: lower is better (a silent recompile regression)
+    (r"(compiles|recompile)", "lower", 0.0),
+    # latency-shaped: lower is better
+    (r"(ttft|tpot|_ms$|_s$|_seconds$|latency|host_blocked|time)", "lower", 0.10),
+)
+
+
+def load_report(path: str) -> dict[str, Any]:
+    """Parse one input file into a raw report dict (see module docstring
+    for the accepted shapes). Raises ValueError on unusable input."""
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    if "tail" in raw and isinstance(raw.get("tail"), str):
+        # driver wrapper: the bench report is the last JSON-object line of
+        # the captured tail (warnings precede it)
+        for line in reversed(raw["tail"].splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                report = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(report, dict):
+                return report
+        # fall back to the driver's parsed headline
+        parsed = raw.get("parsed")
+        if isinstance(parsed, dict):
+            return parsed
+        raise ValueError(f"{path}: wrapper carries no parseable bench report")
+    if "procs" in raw and isinstance(raw.get("procs"), dict):
+        return _rollup_to_report(raw)
+    return raw
+
+
+def _rollup_to_report(rollup: dict[str, Any]) -> dict[str, Any]:
+    """Reduce a series rollup to comparable scalars: per proc, the median
+    of each numeric key over its points (median, not last — one straggler
+    scrape must not define the run)."""
+    out: dict[str, Any] = {}
+    for proc, rec in sorted(rollup.get("procs", {}).items()):
+        values: dict[str, list[float]] = {}
+        for point in rec.get("points", []) or []:
+            if not isinstance(point, dict):
+                continue
+            for k, v in point.items():
+                if k == "ts" or isinstance(v, bool):
+                    continue
+                if isinstance(v, (int, float)):
+                    values.setdefault(k, []).append(float(v))
+        out[proc] = {
+            k: round(statistics.median(vs), 6) for k, vs in values.items()
+        }
+    return out
+
+
+def flatten(obj: Any, prefix: str = "") -> dict[str, float]:
+    """Numeric leaves as dotted keys (bools excluded — they are flags,
+    not measurements; strings and lists are structure, not data)."""
+    out: dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(flatten(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix] = float(obj)
+    return out
+
+
+def rule_for(key: str, rules=DEFAULT_RULES) -> tuple[str, float] | None:
+    for pattern, kind, tol in rules:
+        if re.search(pattern, key):
+            return kind, tol
+    return None
+
+
+def diff(old: dict[str, Any], new: dict[str, Any], *,
+         rules=DEFAULT_RULES, tol_scale: float = 1.0) -> dict[str, Any]:
+    """Compare two loaded reports; the verdict dict. ``ok`` is False iff
+    any judged key regressed past its tolerance (scaled by ``tol_scale``
+    for noisier rigs). The identity diff of any report against itself is
+    ok by construction."""
+    fo, fn = flatten(old), flatten(new)
+    shared = sorted(set(fo) & set(fn))
+    out: dict[str, Any] = {
+        "compared": 0,
+        "regressions": [],
+        "improvements": [],
+        "config_changed": [],
+        "unjudged": [],
+        "only_old": sorted(set(fo) - set(fn)),
+        "only_new": sorted(set(fn) - set(fo)),
+    }
+    for key in shared:
+        r = rule_for(key, rules)
+        if r is None:
+            out["unjudged"].append(key)
+            continue
+        kind, tol = r
+        if kind == "skip":
+            continue
+        a, b = fo[key], fn[key]
+        if kind == "config":
+            if a != b:
+                out["config_changed"].append(
+                    {"key": key, "old": a, "new": b}
+                )
+            continue
+        out["compared"] += 1
+        base = abs(a)
+        delta = (b - a) / base if base > 0 else (0.0 if b == a else float("inf"))
+        tol = tol * tol_scale
+        entry = {
+            "key": key, "old": a, "new": b,
+            "delta_frac": round(delta, 4) if delta != float("inf") else "inf",
+            "tol": tol, "direction": kind,
+        }
+        if kind == "higher":
+            if delta < -tol:
+                out["regressions"].append(entry)
+            elif delta > tol:
+                out["improvements"].append(entry)
+        else:  # lower is better
+            if delta > tol:
+                out["regressions"].append(entry)
+            elif delta < -tol:
+                out["improvements"].append(entry)
+    # worst first: the headline regression leads the report
+    def _sev(e) -> float:
+        d = e["delta_frac"]
+        return float("inf") if d == "inf" else abs(d)
+
+    out["regressions"].sort(key=_sev, reverse=True)
+    out["improvements"].sort(key=_sev, reverse=True)
+    out["ok"] = not out["regressions"]
+    return out
+
+
+def diff_files(old_path: str, new_path: str, *,
+               tol_scale: float = 1.0) -> dict[str, Any]:
+    """Load + diff two report files (the ``tony perf diff`` body)."""
+    verdict = diff(
+        load_report(old_path), load_report(new_path), tol_scale=tol_scale
+    )
+    verdict["old"] = old_path
+    verdict["new"] = new_path
+    return verdict
+
+
+__all__ = [
+    "DEFAULT_RULES", "diff", "diff_files", "flatten", "load_report",
+    "rule_for",
+]

@@ -17,10 +17,18 @@ Endpoints:
                          replay / rejection counts from the frontend
                          ledgers under <app_dir>/serve/ (JSON)
     /api/serve/<app_id>  one app's serving rollup (JSON)
+    /api/series          fleet live-series summary: per-app proc/task
+                         freshness off the series journals + AM rollup
+    /api/series/<app_id> one app's live series (obs/series.py journals +
+                         the AM's heartbeat-path rollup), every proc and
+                         task labelled with its age_s staleness
     /metrics             Prometheus text exposition over every app's
                          registry snapshots (step time / TTFT / TPOT
                          histograms etc., labelled app= and proc=), plus
-                         the portal's own counters
+                         the portal's own LIVE registry (request counts,
+                         chart drops) and a tony_snapshot_age_seconds
+                         gauge per snapshot — a dead host's frozen
+                         metrics are visibly stale, not current
     /healthz             numerics-health verdicts for every app (JSON;
                          obs/health.py rollup)
     /healthz/<app_id>    one app's verdict rollup — HTTP 200 healthy/
@@ -36,6 +44,7 @@ import html
 import json
 import os
 import re
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tony_tpu.am.events import read_history
@@ -72,6 +81,22 @@ class PortalData:
         # counts ONCE, however many times its page is re-rendered — the
         # counter must track NaN production, not page views
         self._drop_seen: set[tuple] = set()
+
+    # the full route vocabulary: labels stay bounded however hostile the
+    # traffic (a crawler probing /wp-login must not mint counter children)
+    _ROUTES = frozenset({"/", "job", "api", "metrics", "healthz"})
+
+    def count_request(self, route: str) -> None:
+        """The live half of /metrics: requests served by THIS portal
+        process, labelled by NORMALIZED top-level route — proof a scrape
+        is hitting a live process, next to the snapshot-derived (and
+        staleness-labelled) per-app series."""
+        route = route or "/"
+        self.registry.counter(
+            "tony_portal_requests_total",
+            "HTTP requests served by this portal process",
+            route=route if route in self._ROUTES else "other",
+        ).inc()
 
     def jobs(self) -> list[dict]:
         out = []
@@ -154,10 +179,17 @@ class PortalData:
     def metric_snapshots(self) -> list[tuple[dict, list[dict]]]:
         """Every registry snapshot under every app's ``metrics/`` dir, as
         (extra-labels, entries) pairs for registry.render_snapshots — the
-        fit()/engine/AM shutdown snapshots become one fleet-wide scrape."""
+        fit()/engine/AM shutdown snapshots become one fleet-wide scrape.
+
+        Each snapshot additionally carries a synthetic
+        ``tony_snapshot_age_seconds`` gauge (file mtime age): every series
+        derived from that snapshot is thereby staleness-labelled — a dead
+        host's frozen histogram scrapes as N-seconds-old data, never as a
+        current reading."""
         out: list[tuple[dict, list[dict]]] = []
         if not os.path.isdir(self.apps_root):
             return out
+        now = time.time()
         for app_id in sorted(os.listdir(self.apps_root)):
             mdir = os.path.join(self.apps_root, app_id, "metrics")
             if not os.path.isdir(mdir):
@@ -165,15 +197,91 @@ class PortalData:
             for name in sorted(os.listdir(mdir)):
                 if not name.endswith(".json"):
                     continue
-                snap = _read_json(os.path.join(mdir, name))
+                path = os.path.join(mdir, name)
+                snap = _read_json(path)
                 if not isinstance(snap, dict):
                     continue
                 entries = snap.get("metrics")
                 if isinstance(entries, list):
+                    try:
+                        age = max(now - os.path.getmtime(path), 0.0)
+                    except OSError:
+                        age = 0.0
+                    entries = list(entries) + [{
+                        "kind": "gauge",
+                        "name": "tony_snapshot_age_seconds",
+                        "help": "age of the registry snapshot these "
+                                "app/proc series were rendered from "
+                                "(stale = a process that stopped writing)",
+                        "labels": {},
+                        "value": round(age, 1),
+                    }]
                     out.append((
                         {"app": app_id, "proc": snap.get("proc", name[:-5])},
                         entries,
                     ))
+        return out
+
+    def series_rollup(self, app_id: str) -> dict | None:
+        """One app's live series: the per-proc journal rollup
+        (obs/series.py ``fleet_rollup``) merged with the AM's heartbeat-
+        path rollup — every proc/task labelled with its ``age_s``. None
+        for unknown app ids."""
+        from tony_tpu.obs.series import fleet_rollup
+
+        if not _APP_ID_RE.match(app_id):
+            return None
+        app_dir = os.path.join(self.apps_root, app_id)
+        if not os.path.isdir(app_dir):
+            return None
+        roll = fleet_rollup(app_dir)
+        out = {"app_id": app_id, "procs": roll["procs"]}
+        am_roll = _read_json(os.path.join(app_dir, "series", "am_rollup.json"))
+        if isinstance(am_roll, dict):
+            # re-label staleness against NOW, not the AM's write time: a
+            # dead AM leaves a frozen rollup whose embedded ages lie
+            now = time.time()
+            tasks = {}
+            for tid, rec in (am_roll.get("tasks") or {}).items():
+                rec = dict(rec or {})
+                last = float(rec.get("last_ts", 0.0) or 0.0)
+                rec["age_s"] = round(max(now - last, 0.0), 1)
+                tasks[tid] = rec
+            out["am_rollup"] = {
+                "rollup_age_s": round(
+                    max(now - float(am_roll.get("ts", 0.0) or 0.0), 0.0), 1
+                ),
+                "tasks": tasks,
+            }
+        return out
+
+    def series_summaries(self) -> dict[str, dict]:
+        """Fleet ``/api/series`` view: per-app proc freshness from journal
+        mtimes (stat calls only — the fleet scrape must NOT parse every
+        journal; the per-app ``/api/series/<id>`` endpoint does the full
+        read). Apps with neither journals nor an AM rollup are omitted."""
+        from tony_tpu.obs.series import freshness
+
+        out: dict[str, dict] = {}
+        if not os.path.isdir(self.apps_root):
+            return out
+        now = time.time()
+        for app_id in sorted(os.listdir(self.apps_root)):
+            if not _APP_ID_RE.match(app_id):
+                continue
+            app_dir = os.path.join(self.apps_root, app_id)
+            procs: dict[str, dict] = dict(freshness(app_dir, now=now))
+            am_roll = _read_json(
+                os.path.join(app_dir, "series", "am_rollup.json")
+            )
+            if isinstance(am_roll, dict):
+                for tid, rec in (am_roll.get("tasks") or {}).items():
+                    last = float((rec or {}).get("last_ts", 0.0) or 0.0)
+                    procs.setdefault(
+                        tid, {"age_s": round(max(now - last, 0.0), 1)}
+                    )
+            if procs:
+                out[app_id] = {"procs": procs}
         return out
 
     def prometheus(self) -> str:
@@ -445,6 +553,7 @@ def make_handler(data: PortalData):
 
         def do_GET(self):  # noqa: N802 (stdlib casing)
             parts = [p for p in self.path.split("/") if p]
+            data.count_request(parts[0] if parts else "/")
             if not parts:
                 return self._send(200, _jobs_html(data.jobs()))
             if parts[0] == "metrics" and len(parts) == 1:
@@ -474,6 +583,16 @@ def make_handler(data: PortalData):
                     )
                 if len(parts) == 3 and parts[1] == "serve":
                     s = data.serve_summary(parts[2])
+                    if s is not None:
+                        return self._send(200, json.dumps(s), "application/json")
+                    return self._send(404, "{}", "application/json")
+                if len(parts) == 2 and parts[1] == "series":
+                    return self._send(
+                        200, json.dumps(data.series_summaries()),
+                        "application/json",
+                    )
+                if len(parts) == 3 and parts[1] == "series":
+                    s = data.series_rollup(parts[2])
                     if s is not None:
                         return self._send(200, json.dumps(s), "application/json")
                     return self._send(404, "{}", "application/json")

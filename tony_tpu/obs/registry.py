@@ -152,19 +152,61 @@ class Histogram:
         Clamps to the largest finite bound when the rank falls in the
         +Inf bucket (Prometheus ``histogram_quantile`` semantics) — size
         buckets to the workload or the top quantiles saturate."""
-        if self._count == 0:
-            return 0.0
-        rank = q * self._count
-        acc = 0
-        lo = 0.0
-        for i, c in enumerate(self._counts):
-            if acc + c >= rank and c > 0:
-                hi = self.bounds[i] if i < len(self.bounds) else self.bounds[-1]
-                frac = (rank - acc) / c
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-            acc += c
-            lo = self.bounds[i] if i < len(self.bounds) else self.bounds[-1]
-        return self.bounds[-1]
+        with self._lock:
+            counts = list(self._counts)
+        return quantile_from_counts(self.bounds, counts, q)
+
+
+def quantile_from_counts(bounds: tuple[float, ...], counts: list[int],
+                         q: float) -> float:
+    """The bucket-interpolation rule over an explicit per-bucket count
+    vector (``Histogram.quantile`` and the windowed-delta readers share
+    it — ONE quantile semantics for cumulative and since-last-scrape)."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    acc = 0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        if acc + c >= rank and c > 0:
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            frac = (rank - acc) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        acc += c
+        lo = bounds[i] if i < len(bounds) else bounds[-1]
+    return bounds[-1]
+
+
+class HistogramWindow:
+    """Since-last-call quantile reader over histograms.
+
+    A cumulative histogram answers "over the whole run"; a live series
+    point wants "since the last scrape" — p99 TTFT *now*, not blended
+    with an hour-old warmup. ``delta(hist)`` diffs the per-bucket counts
+    against this window's previous reading of the same histogram family
+    and returns ``{count, p50, p99}`` over just the new observations
+    (zeros when nothing landed). A replaced histogram object (engine
+    ``reset_metrics`` builds a fresh registry) re-baselines from zero
+    instead of reporting negative deltas."""
+
+    def __init__(self) -> None:
+        self._prev: dict[str, tuple[Any, list[int]]] = {}
+
+    def delta(self, hist: Histogram) -> dict[str, float]:
+        with hist._lock:
+            counts = list(hist._counts)
+        prev_obj, prev_counts = self._prev.get(hist.name, (None, None))
+        if prev_obj is not hist or prev_counts is None:
+            prev_counts = [0] * len(counts)
+        d = [max(a - b, 0) for a, b in zip(counts, prev_counts)]
+        self._prev[hist.name] = (hist, counts)
+        n = sum(d)
+        return {
+            "count": float(n),
+            "p50": quantile_from_counts(hist.bounds, d, 0.5),
+            "p99": quantile_from_counts(hist.bounds, d, 0.99),
+        }
 
 
 class Registry:
@@ -318,7 +360,7 @@ def snapshot_to_app_dir(proc: str, registry: Registry | None = None) -> str:
 
 
 __all__ = [
-    "Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram", "Registry",
-    "get_registry", "render_snapshots", "snapshot_to_app_dir",
-    "write_snapshot",
+    "Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram", "HistogramWindow",
+    "Registry", "get_registry", "quantile_from_counts", "render_snapshots",
+    "snapshot_to_app_dir", "write_snapshot",
 ]

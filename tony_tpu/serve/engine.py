@@ -37,6 +37,7 @@ from __future__ import annotations
 import functools
 import logging
 import time
+import weakref
 from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -48,10 +49,10 @@ import numpy as np
 from jax import lax
 
 from tony_tpu.models.llama import LlamaConfig, Params, rms_norm, rope_freqs
-from tony_tpu.obs import hbm, health, trace
+from tony_tpu.obs import hbm, health, series, slo, trace
 from tony_tpu.obs import compiles as compile_ledger
 from tony_tpu.obs.metrics import DecodeMetrics
-from tony_tpu.obs.registry import Registry, snapshot_to_app_dir
+from tony_tpu.obs.registry import HistogramWindow, Registry, snapshot_to_app_dir
 from tony_tpu.ops.decode_attention import decode_attention
 from tony_tpu.serve.cache import (
     BlockKVCache, blocks_for, create_cache, grow_cache, shrink_cache,
@@ -146,6 +147,23 @@ def _as_raw_key(rng: Any, rid: int) -> jnp.ndarray:
     if jnp.issubdtype(arr.dtype, jax.dtypes.prng_key):
         return jax.random.key_data(arr).astype(jnp.uint32)
     return arr.astype(jnp.uint32)
+
+
+def _weak_stats_source(engine: "Engine", recorder, key: str):
+    """A series source that does not own the engine: the closure holds a
+    weakref, so an engine dropped without close() (failed construction,
+    abandoned bench sweep) is collectable — and the first scrape after
+    collection detaches the dead source instead of erroring forever."""
+    ref = weakref.ref(engine)
+
+    def source() -> dict:
+        eng = ref()
+        if eng is None:
+            recorder.detach(key)
+            return {}
+        return eng.stats_snapshot(windowed=True)
+
+    return source
 
 
 def _default_buckets(max_len: int) -> tuple[int, ...]:
@@ -245,6 +263,19 @@ class Engine:
         # attribution; disarmed, none of it is compiled in
         health.install_from_env()
         self._monitors = health.active_sentinel() is not None
+        # live time-series (obs/series.py): the engine publishes its
+        # stats_snapshot() as a scrape source — queue depth, occupancy,
+        # windowed TTFT/TPOT quantiles — so the recorder (and the SLO
+        # engine riding it) never walks private engine state. The source
+        # itself attaches at the END of __init__ (after the registry it
+        # reads exists) and holds only a weakref: an engine abandoned
+        # without close() must not be pinned — params + KV cache — by the
+        # process-global recorder forever.
+        series.install_from_env()
+        self._series = series.active_recorder()
+        self._snap_window = HistogramWindow()   # since-last-scrape quantiles
+        self._snap_prev: dict[str, float] = {}  # counter deltas (error rate)
+        self._series_key = f"engine@{id(self):x}"
         self._ledger = compile_ledger.get_ledger()
         self._compiles_t0 = self._ledger.backend_compiles
         # engine-scoped watermark mark: close() reports THIS engine's peak
@@ -256,6 +287,11 @@ class Engine:
         self._queued_spans: dict[int, Any] = {}
         self._decode_spans: dict[int, Any] = {}
         self._first_tok_t: dict[int, float] = {}
+        if self._series is not None:
+            self._series.attach(
+                self._series_key,
+                _weak_stats_source(self, self._series, self._series_key),
+            )
 
     # --- public API -----------------------------------------------------------
 
@@ -317,6 +353,56 @@ class Engine:
         reset_metrics()."""
         return int(self._c_rejected.value)
 
+    def stats_snapshot(self, windowed: bool = False) -> dict[str, float]:
+        """Cheap host-side stats: queue depth, slot occupancy, token/
+        request counters, and TTFT/TPOT/step-time quantiles. ONE public
+        surface for every consumer — the series recorder, the gang
+        ``DecodeStats`` RPC, and the gang worker's AM metrics push — so
+        none of them walks private engine state, and none syncs a device
+        (everything here is host counters).
+
+        ``windowed=True`` reports quantiles *since the previous windowed
+        call* (the series recorder's live view: p99 TTFT now, not blended
+        with warmup); the default reports run-cumulative quantiles (the
+        RPC/stats view). The windowed state is single-consumer by design
+        — only the engine's own series source uses it."""
+        snap: dict[str, float] = {
+            "queue_depth": float(len(self._queue)),
+            "live_slots": float(self.n_live),
+            "slots": float(self.serve.slots),
+            "occupancy": round(self.n_live / max(self.serve.slots, 1), 4),
+            "generated_tokens": float(self._c_tokens.value),
+            "requests_finished": float(self._c_finished.value),
+            "rejected_total": float(self._c_rejected.value),
+        }
+        for hist, prefix in (
+            (self._h_ttft, "ttft"),
+            (self._h_tpot, "tpot"),
+            (self._h_step, "decode_step"),
+        ):
+            if windowed:
+                d = self._snap_window.delta(hist)
+                if d["count"]:
+                    snap[f"{prefix}_p50_s"] = round(d["p50"], 4)
+                    snap[f"{prefix}_p99_s"] = round(d["p99"], 4)
+                    snap[f"{prefix}_n"] = d["count"]
+            elif hist.count:
+                snap[f"{prefix}_p50_s"] = round(hist.quantile(0.5), 4)
+                snap[f"{prefix}_p99_s"] = round(hist.quantile(0.99), 4)
+                snap[f"{prefix}_n"] = float(hist.count)
+        if windowed:
+            # windowed serve error rate: explicit rejections over requests
+            # resolved in the window (the slo.error_rate input); the
+            # engine itself has no other error class — relay/transport
+            # errors are the frontend ledger's to count
+            rej = snap["rejected_total"] - self._snap_prev.get("rejected", 0.0)
+            fin = snap["requests_finished"] - self._snap_prev.get("finished", 0.0)
+            self._snap_prev["rejected"] = snap["rejected_total"]
+            self._snap_prev["finished"] = snap["requests_finished"]
+            if rej + fin > 0:
+                snap["error_rate"] = round(rej / (rej + fin), 4)
+        return snap
+
     def _init_registry(self) -> None:
         reg = self.registry = Registry()
         self._h_ttft = reg.histogram("tony_ttft_seconds",
@@ -348,6 +434,10 @@ class Engine:
             decode_compiles=len(self._decode_fns),
         )
         self._init_registry()
+        # windowed-snapshot baselines re-base with the counters: a stale
+        # pre-reset baseline would report negative error-rate deltas
+        # (HistogramWindow re-bases itself on the fresh histogram objects)
+        self._snap_prev.clear()
         self._g_queue.set(len(self._queue))
 
     def close(self) -> dict:
@@ -386,6 +476,22 @@ class Engine:
                 s["health_trips"] = trips
             sentinel.export(self.registry)
             sentinel.write_verdict()
+        # live series + SLO teardown: final scrape drained, source
+        # detached (a recreated engine must not leave a stale closure
+        # scraping freed state), verdict persisted — `met` verdicts exist
+        # on disk too, so absence stays distinguishable from success
+        if self._series is not None:
+            self._series.force_sample()
+            self._series.drain()
+            self._series.detach(self._series_key)
+        slo_engine = slo.active_engine()
+        if slo_engine is not None:
+            s["slo_verdict"] = slo_engine.verdict
+            trips = slo_engine.trip_counts()
+            if trips:
+                s["slo_trips"] = trips
+            slo_engine.export(self.registry)
+            slo_engine.write_verdict()
         watch = hbm.active_watch()
         if watch is not None and self._hbm_mark is not None:
             peak_gb, peak_exact = watch.peak_since(self._hbm_mark)
@@ -638,6 +744,7 @@ class Engine:
             health.sample(
                 metrics=hmon, slot_rids=slot_rids, live_slots=live_before
             )
+        series.sample()  # stride-counted scrape of the attached sources
         self._h_step.observe(dt)
         self._c_tokens.inc(len(live_before))
         for s in live_before:

@@ -47,7 +47,7 @@ from typing import Sequence
 
 import grpc
 
-from tony_tpu.obs import trace
+from tony_tpu.obs import series, trace
 from tony_tpu.obs.registry import Registry, write_snapshot
 from tony_tpu.rpc import ApplicationRpcClient, ServeRpcClient, pb
 from tony_tpu.serve.gang import GangSettings
@@ -221,10 +221,38 @@ class GangFrontend:
         # looking free to every other job in the store (double-booking)
         self._grow_ask = grow_ask
         self.autoscale_actions: list[tuple[str, str]] = []  # (action, detail)
+        # gang-level live series (obs/series.py): the frontend publishes
+        # fleet aggregates — routable hosts, summed queue depth, inflight,
+        # windowed gang TTFT — as a scrape source; the stats loop is its
+        # sampling cadence (the frontend has no step loop)
+        self._fleet_depth = 0
+        self._series = series.active_recorder()
+        self._series_key = f"frontend@{id(self):x}"
+        if self._series is not None:
+            from tony_tpu.obs.registry import HistogramWindow
+
+            self._ttft_window = HistogramWindow()
+            self._series.attach(self._series_key, self._series_source)
         self._stats_thread = threading.Thread(
             target=self._stats_loop, daemon=True, name="frontend-stats"
         )
         self._stats_thread.start()
+
+    def _series_source(self) -> dict:
+        out = {
+            "gang_hosts": float(self._g_hosts.value),
+            "queue_depth": float(self._fleet_depth),
+            "inflight": float(self._g_inflight.value),
+            "requests_total": float(self._c_submitted.value),
+            "replays_total": float(self._c_replays.value),
+            "rejected_total": float(self._c_rejected.value),
+        }
+        d = self._ttft_window.delta(self._h_ttft)
+        if d["count"]:
+            out["ttft_p50_s"] = round(d["p50"], 4)
+            out["ttft_p99_s"] = round(d["p99"], 4)
+            out["ttft_n"] = d["count"]
+        return out
 
     # --- discovery / stats ----------------------------------------------------
 
@@ -337,7 +365,9 @@ class GangFrontend:
                     # decide on their own stream errors
                     h.stats = None
             self._g_hosts.set(self._routable_count())
+            self._fleet_depth = depth
             self.autoscale_tick(depth)
+            series.sample()  # stride-counted gang-level series scrape
 
     # --- autoscale ------------------------------------------------------------
 
@@ -736,6 +766,10 @@ class GangFrontend:
             except OSError:
                 log.debug("frontend registry snapshot failed", exc_info=True)
         self._stats_thread.join(timeout=2.0)
+        if self._series is not None:
+            self._series.force_sample()
+            self._series.drain()
+            self._series.detach(self._series_key)
         for h in self._snapshot_hosts():
             try:
                 h.client.close()

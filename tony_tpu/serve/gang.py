@@ -162,6 +162,12 @@ class DecodeHostService(ServeRpcServicer):
     # enough that a fresh submission starts prefilling promptly
     _IDLE_WAIT_S = 0.05
 
+    # serve-host series cadence over the AM metrics RPC: the fleet rollup
+    # and `tony top`'s per-host rows come from these pushes (the same
+    # heartbeat-path channel fit() uses), so a decode host is as visible
+    # as a trainer
+    _PUSH_INTERVAL_S = 2.0
+
     def __init__(self, engine_factory: Callable[[], Engine], host_id: str,
                  drain_timeout_s: float = 30.0):
         self._engine_factory = engine_factory
@@ -172,6 +178,13 @@ class DecodeHostService(ServeRpcServicer):
         self._draining = False
         self._started = threading.Event()
         self._start_error: BaseException | None = None
+        # stats push AM-ward (obs/reporter.py: bounded queue + daemon
+        # drain — an AM stall can never block the engine loop); inert
+        # outside a tony job (no TONY_AM_ADDR)
+        from tony_tpu.obs.reporter import MetricsReporter
+
+        self._reporter = MetricsReporter()
+        self._last_push = 0.0
         # live per-request plumbing, owned by the engine thread; the lock
         # only guards the dict shape (handler threads read membership for
         # stats), never any blocking work
@@ -200,7 +213,10 @@ class DecodeHostService(ServeRpcServicer):
                 idle = not self._streams
             if idle and not (eng.queue_depth or eng.n_live):
                 # nothing in flight: block on the mailbox instead of
-                # spinning the decode step against an empty engine
+                # spinning the decode step against an empty engine. The
+                # stats push still ticks — an IDLE host must read as
+                # fresh-and-empty on `tony top`, not as stale
+                self._push_stats(eng)
                 try:
                     item = self._mailbox.get(timeout=self._IDLE_WAIT_S)
                 except queue.Empty:
@@ -209,7 +225,28 @@ class DecodeHostService(ServeRpcServicer):
                 continue
             eng.step()
             self._publish(eng)
+            self._push_stats(eng)
         eng.close()
+
+    def _push_stats(self, eng: Engine, force: bool = False) -> None:
+        """Throttled DecodeStats push to the AM + a series scrape
+        (engine thread only). The scrape here is FORCED, not
+        stride-counted: this path already ticks at the 2s push throttle,
+        and a stride on top of it would let an idle-but-healthy host's
+        journal age past `tony top`'s stale threshold (stride x
+        throttle = ~32s > 30s) — an idle host must read as
+        fresh-and-empty, never as stale."""
+        now = time.monotonic()
+        if not force and now - self._last_push < self._PUSH_INTERVAL_S:
+            return
+        self._last_push = now
+        from tony_tpu.obs import series
+
+        recorder = series.active_recorder()
+        if recorder is not None:
+            recorder.force_sample()
+        if self._reporter.active:
+            self._reporter.push(eng.stats_snapshot())
 
     def _apply_mailbox(self, eng: Engine) -> Engine:
         while True:
@@ -314,17 +351,20 @@ class DecodeHostService(ServeRpcServicer):
                 host_id=self.host_id, draining=self._draining,
                 in_flight=pending,
             )
-        m = eng.metrics
+        # ONE stats surface (Engine.stats_snapshot): the RPC, the series
+        # recorder, and the AM push all read the same snapshot — the RPC
+        # never walks private engine state
+        snap = eng.stats_snapshot()
         return pb.DecodeStatsResponse(
             host_id=self.host_id,
-            slots=eng.serve.slots,
-            live_slots=eng.n_live,
-            queue_depth=eng.queue_depth + pending,
+            slots=int(snap["slots"]),
+            live_slots=int(snap["live_slots"]),
+            queue_depth=int(snap["queue_depth"]) + pending,
             in_flight=streaming + pending,
-            generated_tokens=int(m.generated_tokens),
-            rejected_total=int(eng.rejected_total),
+            generated_tokens=int(snap["generated_tokens"]),
+            rejected_total=int(snap["rejected_total"]),
             draining=self._draining,
-            occupancy=eng.n_live / max(eng.serve.slots, 1),
+            occupancy=snap["occupancy"],
         )
 
     def Drain(self, request, context):  # noqa: N802
@@ -363,6 +403,7 @@ class DecodeHostService(ServeRpcServicer):
         for s in streams:
             s.reject("error", "host shutting down")
         self._thread.join(timeout=30.0)
+        self._reporter.close(timeout=2.0)
 
 
 class _StreamState:

@@ -41,10 +41,10 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from tony_tpu.models.llama import LlamaConfig, train_flops_per_token
-from tony_tpu.obs import hbm, health, trace
+from tony_tpu.obs import hbm, health, series, slo, trace
 from tony_tpu.obs import compiles as compile_ledger
 from tony_tpu.obs.metrics import StepTimer, chip_peak_flops
-from tony_tpu.obs.registry import Registry, snapshot_to_app_dir
+from tony_tpu.obs.registry import HistogramWindow, Registry, snapshot_to_app_dir
 from tony_tpu.parallel.mesh import MeshShape, build_mesh
 from tony_tpu.parallel.sharding import DEFAULT_RULES, Rules, spec_for
 from tony_tpu.runtime import jax_tpu
@@ -132,6 +132,11 @@ def fit(cfg: FitConfig) -> dict:
     # BEFORE the train step is built, so the in-graph value monitors are
     # fused into it (obs/health.py, docs/OBS.md "Numerics health")
     health.install_from_env()
+    # arm the live time-series recorder + SLO engine (idempotent;
+    # TONY_OBS_SERIES=0 disables): stride-scraped step/goodput/HBM points
+    # journal under the app dir and feed burn-rate alerting
+    # (obs/series.py, obs/slo.py, docs/OBS.md "SLO + time series")
+    series.install_from_env()
     with diagnostics_context(), trace.span("train.fit", steps=cfg.steps) as root:
         with hbm.oom_guard("fit"):
             return _fit(cfg, root)
@@ -379,6 +384,33 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     )
     from tony_tpu.obs.profiler import annotate
 
+    # live-series source: step progress, since-last-scrape step-time
+    # quantiles, and the goodput split — read on scrape stride hits only,
+    # all host-side locals (the closure reads the loop's live variables;
+    # no device sync ever happens here). The SLO engine's
+    # step_time_p99_s / goodput_floor inputs come from these keys.
+    recorder = series.active_recorder()
+    step = start_step  # the source may be scraped before the first step
+    step_window = HistogramWindow()
+
+    def _series_source() -> dict:
+        out = {"step": float(step + 1)}
+        if steady_t0 is not None:
+            elapsed = max(time.perf_counter() - steady_t0, 1e-9)
+            out["host_blocked_frac"] = round(host_steady_s / elapsed, 4)
+            out["goodput_frac"] = round(
+                max(1.0 - host_steady_s / elapsed, 0.0), 4
+            )
+        d = step_window.delta(h_step)
+        if d["count"]:
+            out["step_time_p50_s"] = round(d["p50"], 4)
+            out["step_time_p99_s"] = round(d["p99"], 4)
+            out["step_time_n"] = d["count"]
+        return out
+
+    if recorder is not None:
+        recorder.attach("fit", _series_source)
+
     # runtime sanitizer (GRAFT_SANITIZE=1, analysis/sanitize.py): armed
     # once the first step has fully resolved — steady state must neither
     # implicitly host-sync nor compile (docs/ANALYSIS.md "Sanitizer")
@@ -437,6 +469,9 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             # the sentinel's worker thread (the device_get sync happens
             # there, never here — the step loop stays unblocked)
             health.sample(metrics=metrics)
+            # stride-counted series scrape: host-side locals + counters
+            # only; journaling happens on the recorder's writer thread
+            series.sample()
             window += 1
             if pending is not None:
                 _emit(pending)  # previous boundary, now that N+1 is in flight
@@ -489,6 +524,13 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     finally:
         san_stack.close()
         close_batches(batches)
+        if recorder is not None:
+            # final scrape (the shutdown state lands in the journal, and
+            # any last-window SLO trip evaluates) before the source whose
+            # locals are about to die is detached
+            recorder.force_sample()
+            recorder.drain()
+            recorder.detach("fit")
     if manager is not None:
         manager.wait()  # settle async saves before checking what exists
         if manager.latest_step() != cfg.steps:
@@ -518,6 +560,18 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             final["health_trips"] = trips
         sentinel.export(registry)
         sentinel.write_verdict()
+    # SLO verdict (obs/slo.py): the burn-rate engine evaluated async on
+    # the series writer thread (drained above); export tony_slo_* into
+    # the per-run registry and persist the verdict — `met` is recorded,
+    # so a missing verdict stays distinguishable from a passing one
+    slo_engine = slo.active_engine()
+    if slo_engine is not None:
+        final["slo_verdict"] = slo_engine.verdict
+        slo_trips = slo_engine.trip_counts()
+        if slo_trips:
+            final["slo_trips"] = slo_trips
+        slo_engine.export(registry)
+        slo_engine.write_verdict()
     # registry snapshot into the job history (no-op outside a tony job);
     # suffixed so a train-then-serve user process cannot overwrite one
     # component's snapshot with the other's. The HBM gauges export into
