@@ -814,6 +814,82 @@ def health_overhead_bench(steps: int = 20) -> dict:
     }
 
 
+def anatomy_bench(steps: int = 6) -> dict:
+    """Step-anatomy microbench (obs/profile.py + obs/anatomy.py): a
+    shard_map matmul+psum loop over every local device, captured under a
+    real ProfileController window exactly like `tony profile` would — so
+    the judged numbers (overlap_frac higher-better, exposed_collective_ms
+    lower-better, achieved_gbps on the dominant collective) come from the
+    same capture/report path production uses, and a regression in either
+    the overlap behaviour or the anatomy plumbing shows up here."""
+    import tempfile
+
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tony_tpu.obs import anatomy, comms
+    from tony_tpu.obs import profile as profile_mod
+    from tony_tpu.ops.compat import shard_map_compat
+
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(n), ("dp",))
+
+    def f(x, w):
+        h = jnp.dot(x, w)
+        return jax.lax.psum(h, "dp") if n > 1 else h
+
+    sf = jax.jit(shard_map_compat(
+        f, mesh=mesh, in_specs=(P("dp"), P(None, None)),
+        out_specs=P(),
+    )) if n > 1 else jax.jit(f)
+    x = jnp.ones((max(n, 1) * 64, 512), jnp.float32)
+    w = jnp.ones((512, 512), jnp.float32)
+    compiled = sf.lower(x, w).compile()
+    ledger_rows = comms.extract_collectives(compiled)
+    out_root = tempfile.mkdtemp(prefix="tony-anatomy-")
+    ctl = profile_mod.ProfileController(out_root, "bench", watch=False)
+    ctl.trigger(steps=steps)
+    y = compiled(x, w)
+    _fence(y)  # warm outside the window
+    for _ in range(steps + 1):
+        ctl.step(fetch_s=0.0)
+        y = compiled(x, w)
+        _fence(y)
+    ctl.finish()
+    import glob as _glob
+
+    mpaths = _glob.glob(os.path.join(out_root, "bench", "*", "manifest.json"))
+    if not mpaths:
+        return {"error": "no capture manifest landed"}
+    with open(mpaths[-1]) as fh:
+        manifest = json.load(fh)
+    rep = anatomy.proc_report(manifest, ledger_rows)
+    out = {
+        "devices": n,
+        "steps": rep["steps"],
+        "device_trace": rep["device_trace"],
+        "step_ms": rep["per_step_ms"]["step_time_s"],
+        "compute_ms": rep["per_step_ms"]["compute_s"],
+        "exposed_collective_ms": rep["per_step_ms"]["exposed_collective_s"],
+        "host_blocked_ms": rep["per_step_ms"]["host_blocked_s"],
+    }
+    if "overlap_frac" in rep:
+        out["overlap_frac"] = rep["overlap_frac"]
+    top = next(
+        (r for r in rep["collectives"] if r.get("bytes") and r.get("total_s")),
+        None,
+    )
+    if top is not None:
+        out["top_collective"] = {
+            "kind": top["kind"],
+            "bytes": top["bytes"],
+            "mean_us": top.get("mean_us", 0.0),
+        }
+        if "achieved_gbps" in top:
+            out["top_collective"]["achieved_gbps"] = top["achieved_gbps"]
+    return out
+
+
 def _phased(name: str, fn) -> dict:
     """Run one bench section under its own HBM phase watermark; the
     section's dict gains an ``hbm`` key with the phase-scoped numbers
@@ -852,6 +928,7 @@ def run_bench() -> dict:
         extra["health_overhead"] = _phased(
             "health_overhead", health_overhead_bench
         )
+        extra["step_anatomy"] = _phased("step_anatomy", anatomy_bench)
         return {
             "metric": "llama_tiny_cpu_tokens_per_sec",
             "value": r["tokens_per_sec_per_chip"],
@@ -927,6 +1004,7 @@ def run_bench() -> dict:
     extra["decode"] = _phased("decode", lambda: decode_bench(on_tpu=True))
     extra["gqa_capacity"] = _phased("gqa_capacity", gqa_capacity_demo)
     extra["health_overhead"] = _phased("health_overhead", health_overhead_bench)
+    extra["step_anatomy"] = _phased("step_anatomy", anatomy_bench)
     extra["pipeline"] = _phased("pipeline", pipeline_bench)
     extra["submit_to_first_step_s"] = _phased(
         "submit_to_first_step_s", submit_latency_bench

@@ -404,6 +404,30 @@ class TestGL005:
         assert all("disarmed" in f.message for f in fs)
         assert sorted(f.line for f in fs) == [9, 11]
 
+    def test_profile_capture_seam_holds_the_same_contract(self, tmp_path):
+        """The coordinated profiler's maybe_capture() seam (obs/profile.py)
+        is the fifth observatory hook: the wired call shapes (bare call in
+        the serve engine, precomputed fetch_s name in fit()) are clean; an
+        argument that calls or allocates before the armed check fires."""
+        fs = lint_src(tmp_path, {"mod.py": """
+            from tony_tpu.obs import profile
+
+            def hot_loop(step, fetch_s):
+                # the wired call shapes: bare call / bare names
+                profile.maybe_capture()
+                profile.maybe_capture(fetch_s=fetch_s)
+                # eager call argument: evaluated even when disarmed — fires
+                profile.maybe_capture(note=describe(step))
+                # comprehension argument: ditto — fires
+                profile.maybe_capture(vals=[v for v in (step,)])
+
+            def describe(step):
+                return {"step": step}
+        """}, select="GL005")
+        assert len(fs) == 2
+        assert all("disarmed" in f.message for f in fs)
+        assert sorted(f.line for f in fs) == [9, 11]
+
     def test_series_sample_seam_holds_the_same_contract(self, tmp_path):
         """The live-series recorder's sample() seam (obs/series.py) is the
         fourth observatory hook: the wired call shapes (bare call in the

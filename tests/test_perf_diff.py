@@ -40,6 +40,21 @@ class TestFlattenAndRules:
         # headroom is higher-better DESPITE carrying 'hbm': a collapse
         # must flag as a regression, not pass as a memory improvement
         assert rule_for("decode_0.hbm_headroom_frac")[0] == "higher"
+        # step anatomy (obs/anatomy.py): overlap + achieved bandwidth are
+        # higher-better, exposed collective time lower-better
+        assert rule_for("extra.step_anatomy.overlap_frac")[0] == "higher"
+        assert rule_for(
+            "extra.step_anatomy.top_collective.achieved_gbps"
+        )[0] == "higher"
+        assert rule_for(
+            "extra.step_anatomy.exposed_collective_ms"
+        )[0] == "lower"
+        # the payload is program configuration, not a measurement: a
+        # sharding change's bigger all-reduce must report as
+        # config_changed, never as a memory regression
+        assert rule_for(
+            "extra.step_anatomy.top_collective.bytes"
+        )[0] == "config"
 
     def test_headroom_collapse_is_a_regression(self):
         v = diff(
@@ -64,6 +79,11 @@ class TestVerdict:
         assert "extra.tokens_per_sec_per_chip" in keys
         assert "extra.decode.full_slot.ttft_p99_s" in keys
         assert "extra.mfu" in keys
+        # the anatomy section gates too: an overlap collapse, a grown
+        # exposed-collective cost, and a bandwidth drop all flag
+        assert "extra.step_anatomy.overlap_frac" in keys
+        assert "extra.step_anatomy.exposed_collective_ms" in keys
+        assert "extra.step_anatomy.top_collective.achieved_gbps" in keys
         # within-tolerance drift is NOT flagged
         assert "extra.loss" not in keys          # +0.04% << 2%
         assert "extra.peak_hbm_gb" not in keys   # +1.5% << 10%

@@ -363,6 +363,54 @@ def test_disarmed_health_sample_is_within_noise_of_noop():
         health.uninstall()
 
 
+def test_disarmed_profile_capture_is_within_noise_of_noop(tmp_path):
+    """The coordinated profiler's no-op contract (the fifth twin): a
+    maybe_capture() call with no controller armed is one global load +
+    None compare — cheap enough to sit in the train/serve step loops
+    unconditionally. graft-lint GL005 holds the call-site side of the
+    same contract (tests/test_lint.py has the profile fixtures)."""
+    import time
+
+    from tony_tpu.obs import profile
+
+    profile.uninstall()  # other tests may have armed the process
+    N = 50_000
+    for _ in range(1000):
+        profile.maybe_capture()
+    per_call = math.inf
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(N):
+            profile.maybe_capture()
+        per_call = min(per_call, (time.perf_counter() - t0) / N)
+    assert per_call < 5e-6, (
+        f"disarmed profile.maybe_capture costs {per_call * 1e9:.0f}ns/call — "
+        "the no-op path regressed (is something arming a controller or "
+        "allocating?)"
+    )
+    # armed-but-idle (no broadcast window): two attribute compares, no
+    # window ever opens, nothing lands on disk
+    ctl = profile.install(profile.ProfileController(
+        str(tmp_path / "profile"), "guard", watch=False,
+    ))
+    try:
+        per_call = math.inf
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(N):
+                profile.maybe_capture()
+            per_call = min(per_call, (time.perf_counter() - t0) / N)
+        assert per_call < 5e-6, (
+            f"armed-idle profile.maybe_capture costs {per_call * 1e9:.0f}"
+            "ns/call — the off-window path regressed"
+        )
+        assert ctl._req is None and ctl._pending is None
+        assert not (tmp_path / "profile" / "guard").exists()
+        assert ctl is profile.active_controller()
+    finally:
+        profile.uninstall()
+
+
 def test_disarmed_series_sample_is_within_noise_of_noop():
     """The live-series recorder's no-op contract (the fourth twin): a
     sample() call with no recorder armed is one global load + None

@@ -27,7 +27,7 @@ from typing import Any
 
 from tony_tpu.am.events import EventType, EventWriter
 from tony_tpu.chaos import chaos_hook
-from tony_tpu.obs import hbm, health, series, slo, trace
+from tony_tpu.obs import hbm, health, profile as profile_mod, series, slo, trace
 from tony_tpu.am.scheduler import SchedulerHooks, TaskScheduler
 from tony_tpu.am.session import JobState, Session, TaskState, TERMINAL
 from tony_tpu.cluster import make_backend
@@ -205,6 +205,18 @@ class ApplicationMaster(ApplicationRpcServicer):
         # SLO contract (obs/slo.py): the resolved slo.* group as one JSON
         # blob; workers arm a burn-rate engine only when targets are active
         env[slo.ENV_SLO] = slo.SloConfig.from_config(self.config).to_json()
+        # coordinated-profiling contract (obs/profile.py): device-owning
+        # processes watch <app_dir>/profile/request.json for the windows
+        # the StartProfile RPC broadcasts; the AM only exports the knobs
+        env[profile_mod.ENV_ENABLED] = (
+            "1" if self.config.get_bool(Keys.OBS_PROFILE_ENABLED, True) else "0"
+        )
+        env[profile_mod.ENV_POLL] = str(
+            self.config.get_float(Keys.OBS_PROFILE_POLL_S, 0.5)
+        )
+        env[profile_mod.ENV_MAX_STEPS] = str(
+            self.config.get_int(Keys.OBS_PROFILE_MAX_STEPS, 64)
+        )
         log_path = os.path.join(
             self.app_dir, "logs", f"{spec.name}_{index}_attempt{attempt}.log"
         )
@@ -411,6 +423,42 @@ class ApplicationMaster(ApplicationRpcServicer):
             diagnostics=self.session.diagnostics,
             tensorboard_url=self.session.tensorboard_url,
             tasks=self._task_infos(),
+        )
+
+    def StartProfile(self, request, context):  # noqa: N802
+        """Broadcast a bounded profile window to every process of the job
+        (`tony profile <app_id>`; docs/OBS.md "Step anatomy"). The channel
+        is the shared app dir: the request file lands atomically and each
+        armed ProfileController picks it up on its poll — no per-executor
+        RPC fan-out, and a worker mid-relaunch still sees the request when
+        it arms (requests expire, so a stale one can never re-fire)."""
+        steps = max(int(request.steps), 0)
+        duration_s = max(float(request.duration_s), 0.0)
+        if steps <= 0 and duration_s <= 0:
+            return pb.StartProfileResponse(
+                accepted=False, message="need steps > 0 or duration_s > 0"
+            )
+        max_steps = self.config.get_int(Keys.OBS_PROFILE_MAX_STEPS, 64)
+        message = ""
+        if steps > max_steps:
+            message = f"steps clamped {steps} -> {max_steps} (obs.profile.max_steps)"
+            steps = max_steps
+        req = profile_mod.write_request(
+            self.app_dir, steps=steps, duration_s=duration_s
+        )
+        self.events.emit(
+            EventType.METADATA,
+            profile_id=req.id, profile_steps=steps,
+            profile_duration_s=duration_s,
+        )
+        trace.instant(
+            "am.profile_requested", id=req.id, steps=steps,
+            duration_s=duration_s,
+        )
+        log.info("profile %s broadcast (steps=%d duration_s=%.1f)",
+                 req.id, steps, duration_s)
+        return pb.StartProfileResponse(
+            accepted=True, profile_id=req.id, message=message
         )
 
     def StopApplication(self, request, context):  # noqa: N802

@@ -16,8 +16,9 @@ GL004    lock-discipline       blocking calls (sleep, unbounded join/wait/
                                queue-get, file I/O, RPC-ish backend/client
                                calls) while a lock is held; cross-module
                                lock-order inversions
-GL005    disarmed-hook-cost    chaos/trace/hbm/health hook call sites whose
-                               arguments allocate or call before the armed check
+GL005    disarmed-hook-cost    chaos/trace/hbm/health/series/profile hook call
+                               sites whose arguments allocate or call before
+                               the armed check
 =======  ====================  ==============================================
 
 Checkers are tuned to under-approximate (see analysis/callgraph.py): the
@@ -717,6 +718,10 @@ class DisarmedHookCost:
             # obs/series.py): same disarmed-cost contract as the
             # trace/chaos hooks
             return True
+        if parts[-1] == "maybe_capture":
+            # the coordinated profiler's step-boundary seam
+            # (obs/profile.py): one global load + None compare disarmed
+            return len(parts) == 1 or parts[-2] == "profile"
         return False
 
     def _expensive(self, node: ast.expr) -> str | None:
@@ -745,7 +750,7 @@ class DisarmedHookCost:
             # after its own armed check by construction
             if mi.modname.endswith(
                 ("obs.trace", "obs.hbm", "obs.health", "obs.series",
-                 "chaos.faults")
+                 "obs.profile", "chaos.faults")
             ):
                 continue
             for fi in mi.funcs.values():

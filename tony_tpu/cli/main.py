@@ -376,6 +376,86 @@ def cmd_health(args: argparse.Namespace) -> int:
     return 0 if roll["verdict"] == "healthy" else 1
 
 
+def cmd_profile(args: argparse.Namespace) -> int:
+    """``tony profile <app_id> [--steps N | --seconds T]``: ask the AM to
+    broadcast a bounded capture window to every process of the job, wait
+    for the per-process manifests to land, and print the step-anatomy
+    report (docs/OBS.md "Step anatomy"). ``tony profile report <app_id>``
+    reports on an existing capture without triggering a new one."""
+    import time as _time
+
+    from tony_tpu.obs import profile as profile_mod
+    from tony_tpu.obs.anatomy import build_anatomy
+
+    target = list(args.target)
+    report_only = target and target[0] == "report"
+    if report_only:
+        target = target[1:]
+    if len(target) != 1:
+        print("usage: tony profile [report] <app_id>", file=sys.stderr)
+        return 2
+    app_dir = resolve_app_dir(target[0])
+    profile_id = args.id
+
+    if not report_only:
+        addr = _read_am_addr(app_dir)
+        if not addr:
+            print("AM address unknown; application may not be running",
+                  file=sys.stderr)
+            return 1
+        steps = args.steps
+        if steps <= 0 and args.seconds <= 0:
+            steps = 3  # the useful default: three full steps
+        try:
+            with ApplicationRpcClient(
+                addr, timeout_s=10.0, token=read_token(app_dir)
+            ) as c:
+                resp = c.start_profile(steps=steps, duration_s=args.seconds)
+        except grpc.RpcError as e:
+            print(f"AM unreachable: {e}", file=sys.stderr)
+            return 1
+        if not resp.accepted:
+            print(f"profile refused: {resp.message}", file=sys.stderr)
+            return 1
+        profile_id = resp.profile_id
+        note = f" ({resp.message})" if resp.message else ""
+        print(f"profile {profile_id} broadcast{note}; waiting for captures",
+              file=sys.stderr)
+        if args.no_wait:
+            print(json.dumps({"profile_id": profile_id}))
+            return 0
+        # poll for manifests: done when the landed set has been stable for
+        # two rounds (a straggler host finishing later still lands — its
+        # manifest is on disk for a later `tony profile report`)
+        deadline = _time.monotonic() + args.wait
+        seen: set[str] = set()
+        stable = 0
+        while _time.monotonic() < deadline:
+            _time.sleep(1.0)
+            procs = set(profile_mod.read_manifests(app_dir, profile_id))
+            if procs and procs == seen:
+                stable += 1
+                if stable >= 2:
+                    break
+            else:
+                stable = 0
+                seen = procs
+
+    report = build_anatomy(app_dir, profile_id)
+    if not report["procs"]:
+        where = os.path.join(app_dir, "profile")
+        print(
+            f"no capture manifests under {where}"
+            + (f" for {profile_id}" if profile_id else "")
+            + " (no process reached a step boundary inside the window, or "
+            "obs.profile.enabled was false)",
+            file=sys.stderr,
+        )
+        return 1 if not report_only else 2
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_top(args: argparse.Namespace) -> int:
     """Live terminal view of one application (docs/OBS.md "SLO + time
     series"): per-host rows off the series journals + AM rollup,
@@ -573,6 +653,30 @@ def build_parser() -> argparse.ArgumentParser:
         help="inline the forensics bundle contents into the report",
     )
     s.set_defaults(fn=cmd_health)
+
+    s = sub.add_parser(
+        "profile",
+        help="broadcast a bounded fleet capture window (AM StartProfile) "
+             "and print the step-anatomy report; `tony profile report "
+             "<app>` reads an existing capture (docs/OBS.md)",
+    )
+    s.add_argument(
+        "target", nargs="+",
+        help="application id / app-dir path; prefix with `report` to "
+             "report on an existing capture without triggering a new one",
+    )
+    s.add_argument("--steps", type=int, default=0,
+                   help="capture N steps per process (default 3)")
+    s.add_argument("--seconds", type=float, default=0.0,
+                   help="capture a wall-clock window instead of N steps")
+    s.add_argument("--wait", type=float, default=60.0,
+                   help="how long to wait for capture manifests")
+    s.add_argument("--no-wait", action="store_true",
+                   help="trigger and return (report later with "
+                        "`tony profile report`)")
+    s.add_argument("--id", default="",
+                   help="report a specific capture id (default: newest)")
+    s.set_defaults(fn=cmd_profile)
 
     s = sub.add_parser(
         "top",

@@ -105,6 +105,18 @@ class Keys:
     OBS_SERIES_SAMPLE_STEPS = "obs.series.sample_steps"
     # per-process journal rotation size (newest window kept, <= 2x on disk)
     OBS_SERIES_JOURNAL_MB = "obs.series.max_journal_mb"
+    # coordinated fleet profiling (obs/profile.py; docs/OBS.md "Step
+    # anatomy"): `tony profile <app_id>` asks the AM to broadcast a bounded
+    # capture window; every device-owning process records a jax.profiler
+    # device trace into <app_dir>/profile/<proc>/ over the same steps,
+    # and `tony profile report` merges them into the per-step budget table
+    OBS_PROFILE_ENABLED = "obs.profile.enabled"
+    # how often each process polls the broadcast request file (seconds);
+    # the off-window hot-path seam cost is unaffected by this knob
+    OBS_PROFILE_POLL_S = "obs.profile.poll_interval_s"
+    # hard cap on the steps one window may capture (device traces are
+    # big; a typo'd `--steps 100000` must not fill the disk)
+    OBS_PROFILE_MAX_STEPS = "obs.profile.max_steps"
 
     # --- SLOs (obs/slo.py; docs/OBS.md "SLO + time series") ---
     # declared targets, evaluated as multi-window burn rates over the live
@@ -285,6 +297,9 @@ DEFAULTS: dict[str, object] = {
     Keys.OBS_SERIES_ENABLED: True,
     Keys.OBS_SERIES_SAMPLE_STEPS: 16,
     Keys.OBS_SERIES_JOURNAL_MB: 16,
+    Keys.OBS_PROFILE_ENABLED: True,
+    Keys.OBS_PROFILE_POLL_S: 0.5,
+    Keys.OBS_PROFILE_MAX_STEPS: 64,
     Keys.SLO_TTFT_P99_S: 0,
     Keys.SLO_STEP_TIME_P99_S: 0,
     Keys.SLO_GOODPUT_FLOOR: 0,

@@ -116,8 +116,11 @@ class CompileLedger:
             self._entries.append(entry)
 
     def record_aot(self, fn: str, compiled, dur_s: float = 0.0) -> dict:
-        """Journal an ahead-of-time compile with its measured memory plan
-        and FLOPs; returns the entry (bench reuses the numbers)."""
+        """Journal an ahead-of-time compile with its measured memory plan,
+        FLOPs, and the collective set extracted from its optimized HLO
+        (obs/comms.py: op kind, payload bytes, replica groups — what the
+        step-anatomy report pairs measured device-trace time against);
+        returns the entry (bench reuses the numbers)."""
         entry = {
             "ts": time.time(),
             "kind": "aot",
@@ -125,6 +128,14 @@ class CompileLedger:
             "dur_s": round(float(dur_s), 4),
             **aot_analysis(compiled),
         }
+        try:
+            from tony_tpu.obs.comms import extract_collectives
+
+            colls = extract_collectives(compiled)
+            if colls:
+                entry["collectives"] = colls
+        except Exception:
+            pass
         with self._lock:
             self._entries.append(entry)
         return entry

@@ -54,6 +54,18 @@ DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
     # would be judged as a memory improvement): higher is better
     (r"(tokens_per_sec|tok_s|tflops|mfu|goodput|headroom|occupancy|"
      r"slots$|requests_per_s|steps_per_s)", "higher", 0.05),
+    # step anatomy (obs/anatomy.py): overlap (collective time hidden under
+    # compute) and achieved collective bandwidth are higher-better;
+    # exposed collective time lower-better. These must outrank the broad
+    # memory/latency rules: `achieved_gbps` would otherwise be unjudged
+    # and `overlap_frac` has no other match. A collective's payload size
+    # is a STATIC property of the compiled program (configuration
+    # identity, like n_params) — without the config rule the memory
+    # catch-all below would judge a deliberate sharding change's bigger
+    # payload as a perf regression even when the step got faster.
+    (r"top_collective\.bytes", "config", 0.0),
+    (r"(overlap_frac|achieved_gbps)", "higher", 0.05),
+    (r"(exposed_collective)", "lower", 0.10),
     # memory: lower is better, generous tolerance (allocator noise)
     (r"(hbm|bytes|_gb$|_mb$|rss)", "lower", 0.10),
     # compile counts: lower is better (a silent recompile regression)

@@ -164,7 +164,14 @@ def goodput(app_dir: str,
       step spans, scaled by the stride;
     - ``restart_s``: gaps between one task's consecutive user-process
       spans (the relaunch dead time a gang restart costs);
-    - ``window_s``: first span start to last span end across processes.
+    - ``window_s``: first span start to last span end across processes;
+    - ``unattributed_s``: the window time NO bucket claims, reported
+      explicitly instead of silently folding into the denominator — the
+      reconciliation seam between this roll-up and the step-anatomy
+      budget (obs/anatomy.py attributes *inside* the step; whatever
+      neither tool claims is visible here, never hidden). A lower bound:
+      startup phases overlap each other by design, so their sum can
+      exceed their wall share.
     """
     if procs is None:
         procs = load_journals(os.path.join(app_dir, "trace"))
@@ -228,6 +235,15 @@ def goodput(app_dir: str,
     for k in ("productive_s", "compile_s", "restore_s", "first_batch_s",
               "input_blocked_s", "restart_s"):
         out[k] = round(out[k], 3)
+    # explicit residual: window time no bucket above claims (clamped at 0
+    # because the buckets can overlap — see the docstring). Goodput and
+    # the anatomy budget reconcile through this number instead of both
+    # quietly normalising by the window.
+    attributed = sum(
+        out[k] for k in ("productive_s", "compile_s", "restore_s",
+                         "first_batch_s", "input_blocked_s", "restart_s")
+    )
+    out["unattributed_s"] = round(max(out["window_s"] - attributed, 0.0), 3)
     return out
 
 
@@ -292,7 +308,7 @@ def report(app_dir: str,
     journals the caller already parsed."""
     if procs is None:
         procs = load_journals(os.path.join(app_dir, "trace"))
-    return {
+    out = {
         "processes": [
             {"proc": p["proc"], "spans": len(p["spans"]),
              "instants": len(p["instants"]), "open_at_kill": len(p["opens"]),
@@ -302,6 +318,14 @@ def report(app_dir: str,
         "goodput": goodput(app_dir, procs),
         "stragglers": stragglers(app_dir),
     }
+    # pointer at available step-anatomy captures (obs/profile.py): the
+    # op-level drill-down of whatever this roll-up flags as slow
+    from tony_tpu.obs.profile import list_captures
+
+    captures = list_captures(app_dir)
+    if captures:
+        out["profile_captures"] = captures
+    return out
 
 
 __all__ = ["goodput", "load_journals", "merge_chrome", "report", "stragglers"]

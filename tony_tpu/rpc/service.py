@@ -43,6 +43,7 @@ _METHODS: dict[str, tuple[Any, Any]] = {
         pb.GetApplicationStatusResponse,
     ),
     "StopApplication": (pb.StopApplicationRequest, pb.Empty),
+    "StartProfile": (pb.StartProfileRequest, pb.StartProfileResponse),
 }
 
 
@@ -74,6 +75,9 @@ class ApplicationRpcServicer:
         raise NotImplementedError
 
     def StopApplication(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def StartProfile(self, request, context):  # noqa: N802
         raise NotImplementedError
 
 
@@ -283,6 +287,16 @@ class ApplicationRpcClient:
 
     def stop_application(self, reason: str = "") -> None:
         self._call("StopApplication", pb.StopApplicationRequest(reason=reason))
+
+    def start_profile(
+        self, steps: int = 0, duration_s: float = 0.0
+    ) -> pb.StartProfileResponse:
+        """Ask the AM to broadcast a bounded profile window to the fleet
+        (`tony profile <app_id>`; docs/OBS.md "Step anatomy")."""
+        return self._call(
+            "StartProfile",
+            pb.StartProfileRequest(steps=steps, duration_s=duration_s),
+        )
 
     def close(self) -> None:
         self._channel.close()

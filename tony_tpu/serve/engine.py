@@ -49,7 +49,7 @@ import numpy as np
 from jax import lax
 
 from tony_tpu.models.llama import LlamaConfig, Params, rms_norm, rope_freqs
-from tony_tpu.obs import hbm, health, series, slo, trace
+from tony_tpu.obs import hbm, health, profile, series, slo, trace
 from tony_tpu.obs import compiles as compile_ledger
 from tony_tpu.obs.metrics import DecodeMetrics
 from tony_tpu.obs.registry import HistogramWindow, Registry, snapshot_to_app_dir
@@ -272,6 +272,10 @@ class Engine:
         # without close() must not be pinned — params + KV cache — by the
         # process-global recorder forever.
         series.install_from_env()
+        # coordinated profiling (obs/profile.py): a `tony profile` window
+        # broadcast by the AM captures this host's decode steps too — the
+        # maybe_capture seam rides step()
+        profile.install_from_env()
         self._series = series.active_recorder()
         self._snap_window = HistogramWindow()   # since-last-scrape quantiles
         self._snap_prev: dict[str, float] = {}  # counter deltas (error rate)
@@ -453,6 +457,9 @@ class Engine:
                 sp.end(reason="shutdown")
             spans.clear()
         self._first_tok_t.clear()
+        # a profile window still open at shutdown finalises (partial trace
+        # + manifest land) instead of dying with the engine
+        profile.finish_capture()
         s = self.metrics.summary()
         if self._h_ttft.count:
             s["ttft_p50_s"] = round(self._h_ttft.quantile(0.5), 4)
@@ -512,6 +519,10 @@ class Engine:
 
     def step(self) -> int:
         """Admit what fits, run one decode step; returns live-slot count."""
+        # coordinated-profiling seam (one global load + None compare
+        # disarmed): a broadcast window brackets decode steps exactly like
+        # train steps, so `tony profile` anatomises serving hosts too
+        profile.maybe_capture()
         self._admit()
         if self.n_live:
             self._decode_once()

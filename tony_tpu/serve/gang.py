@@ -491,6 +491,12 @@ def main() -> int:
         format="%(asctime)s SERVE %(levelname)s %(name)s: %(message)s",
     )
     trace.install_from_env()
+    # arm the coordinated-profiling watcher BEFORE the engine builds (model
+    # init + first compiles can take minutes): a `tony profile` broadcast
+    # issued meanwhile is picked up the moment decode steps start
+    from tony_tpu.obs import profile
+
+    profile.install_from_env()
     settings = _load_settings()
     host_id = (
         f"{os.environ.get('TONY_JOB_NAME', settings.job_type)}:"

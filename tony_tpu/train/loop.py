@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding
 
 from tony_tpu.models.llama import LlamaConfig, train_flops_per_token
-from tony_tpu.obs import hbm, health, series, slo, trace
+from tony_tpu.obs import hbm, health, profile, series, slo, trace
 from tony_tpu.obs import compiles as compile_ledger
 from tony_tpu.obs.metrics import StepTimer, chip_peak_flops
 from tony_tpu.obs.registry import HistogramWindow, Registry, snapshot_to_app_dir
@@ -137,6 +137,12 @@ def fit(cfg: FitConfig) -> dict:
     # journal under the app dir and feed burn-rate alerting
     # (obs/series.py, obs/slo.py, docs/OBS.md "SLO + time series")
     series.install_from_env()
+    # arm the coordinated-profiling controller (idempotent; TONY_OBS_PROFILE=0
+    # disables): `tony profile <app_id>` broadcasts a bounded window and the
+    # maybe_capture seam in the step loop captures a jax.profiler device
+    # trace into <app_dir>/profile/<proc>/ (obs/profile.py, docs/OBS.md
+    # "Step anatomy")
+    profile.install_from_env()
     with diagnostics_context(), trace.span("train.fit", steps=cfg.steps) as root:
         with hbm.oom_guard("fit"):
             return _fit(cfg, root)
@@ -431,6 +437,11 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
                 fetch_s = time.perf_counter() - t_fetch
                 host_window_s += fetch_s
                 host_steady_s += fetch_s
+            # coordinated-profiling seam: one global load + None compare
+            # disarmed; during an AM-broadcast window this boundary starts/
+            # advances the device-trace capture and attributes this step's
+            # input wait (fetch_s is a precomputed local — GL005)
+            profile.maybe_capture(fetch_s=fetch_s)
             # first step excluded from sampling (like h_step below): its
             # compile/warmup-inflated duration would be stride-scaled by
             # the goodput roll-up, and its fetch is already attributed to
@@ -523,6 +534,10 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
         steady_end = time.perf_counter()  # before checkpoint settling
     finally:
         san_stack.close()
+        # a profile window still open when the loop ends (requested window
+        # longer than the remaining steps, exception mid-capture) finalises
+        # here — the partial trace + manifest land instead of vanishing
+        profile.finish_capture()
         close_batches(batches)
         if recorder is not None:
             # final scrape (the shutdown state lands in the journal, and
