@@ -890,6 +890,90 @@ def anatomy_bench(steps: int = 6) -> dict:
     return out
 
 
+def elastic_bench(steps: int = 18, members: int = 2) -> dict:
+    """Kill-one-member mid-run (tony_tpu/elastic/, docs/ELASTIC.md): an
+    elastic fit over ``members`` device groups shrinks at steps/3 (one
+    member "preempted") and grows back at 2*steps/3, under an armed
+    tracer. Reports lost steps (the no-cold-restart claim: 0), the
+    warm-restart seconds BOTH from the run's own journal and read off
+    `tony trace` goodput's restart_s bucket (the elastic.reshard spans),
+    and the steady-state step-time ratio after shrink (per-member work is
+    constant, so ~1.0 is the target; the dcn2x multislice topology maps
+    members onto slices the same way)."""
+    import statistics
+    import tempfile
+
+    from tony_tpu.config.config import TonyConfig
+    from tony_tpu.elastic.protocol import journal_files, read_journal
+    from tony_tpu.models.llama import LlamaConfig
+    from tony_tpu.obs import trace
+    from tony_tpu.obs.trace_tool import goodput
+    from tony_tpu.train import FitConfig, fit
+    from tony_tpu.train.data import DataConfig
+
+    app_dir = tempfile.mkdtemp(prefix="tony-elastic-bench-")
+    trace.install_from_config(
+        TonyConfig.load(overrides={"trace.sample_steps": 1}),
+        app_dir, "elastic-bench", proc="bench_elastic",
+    )
+    shrink_at, grow_at = steps // 3, (2 * steps) // 3
+    seq = 64
+    data = DataConfig(global_batch=8, seq_len=seq, vocab_size=256)
+    marks: list[dict] = []
+    try:
+        out = fit(FitConfig(
+            model=LlamaConfig.tiny(),
+            data=data, steps=steps, log_every=1, warmup_steps=2,
+            elastic_members=members,
+            elastic_plan={
+                shrink_at: tuple(range(members - 1)),
+                grow_at: tuple(range(members)),
+            },
+            elastic_dir=app_dir,
+            on_metrics=lambda m: marks.append(dict(m)),
+        ))
+    finally:
+        trace.uninstall()
+    g = goodput(app_dir)
+    per_member = data.global_batch // members
+
+    def _step_time(phase_members: int, lo: int, hi: int) -> float:
+        # per-step wall time from the per-boundary throughput samples:
+        # tokens in the window / tokens-per-sec (batch scales with the
+        # live membership)
+        ts = [
+            phase_members * per_member * seq / m["tokens_per_sec"]
+            for m in marks
+            if lo < m["step"] <= hi and m.get("tokens_per_sec")
+        ]
+        return statistics.median(ts) if ts else 0.0
+
+    full = _step_time(members, 2, shrink_at)          # warmup excluded
+    shrunk = _step_time(members - 1, shrink_at + 1, grow_at)
+    lost = sum(
+        r.get("lost_steps", 0)
+        for p in journal_files(app_dir)
+        for r in read_journal(p)
+        if r.get("type") == "reshard"
+    )
+    section = {
+        "members": members,
+        "steps": steps,
+        "reshards": out.get("elastic", {}).get("reshards", 0),
+        "lost_steps": lost,
+        "restart_s": out.get("elastic", {}).get("reshard_s", 0.0),
+        "goodput": {
+            "restart_s": g.get("restart_s", 0.0),
+            "generation_changes": g.get("generation_changes", 0),
+        },
+    }
+    if full > 0 and shrunk > 0:
+        section["step_time_full_ms"] = round(full * 1e3, 2)
+        section["step_time_shrunk_ms"] = round(shrunk * 1e3, 2)
+        section["shrunk_step_ratio"] = round(shrunk / full, 3)
+    return section
+
+
 def _phased(name: str, fn) -> dict:
     """Run one bench section under its own HBM phase watermark; the
     section's dict gains an ``hbm`` key with the phase-scoped numbers
@@ -929,6 +1013,7 @@ def run_bench() -> dict:
             "health_overhead", health_overhead_bench
         )
         extra["step_anatomy"] = _phased("step_anatomy", anatomy_bench)
+        extra["elastic"] = _phased("elastic", elastic_bench)
         return {
             "metric": "llama_tiny_cpu_tokens_per_sec",
             "value": r["tokens_per_sec_per_chip"],
@@ -1005,6 +1090,7 @@ def run_bench() -> dict:
     extra["gqa_capacity"] = _phased("gqa_capacity", gqa_capacity_demo)
     extra["health_overhead"] = _phased("health_overhead", health_overhead_bench)
     extra["step_anatomy"] = _phased("step_anatomy", anatomy_bench)
+    extra["elastic"] = _phased("elastic", elastic_bench)
     extra["pipeline"] = _phased("pipeline", pipeline_bench)
     extra["submit_to_first_step_s"] = _phased(
         "submit_to_first_step_s", submit_latency_bench

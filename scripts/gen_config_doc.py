@@ -169,8 +169,23 @@ def _fit_config_section() -> list[str]:
         "moe_group_block": "grouped-GEMM row tile override (0 keeps "
                            "`model.moe_group_block`); each expert's ragged "
                            "token group pads up to a multiple of this",
+        "elastic_members": "elastic gang size at full strength (0 disables; "
+                           ">= 2 makes the mesh runtime-swappable — dp maps "
+                           "to members and shrinks/grows at generation "
+                           "boundaries, docs/ELASTIC.md). In-job this arms "
+                           "from the TONY_ELASTIC* env",
+        "elastic_dir": "generation-broadcast + journal root; empty uses "
+                       "TONY_APP_DIR (the shared app dir the AM writes "
+                       "generation.json into)",
+        "elastic_shadow_steps": "async device->host checkpoint-shadow "
+                                "stride in steps (0 -> env/default 16); "
+                                "each shadow briefly holds one extra state "
+                                "replica on device",
     }
-    skip = {"model", "data", "rules", "mesh_shape", "on_metrics"}
+    # structured Python values with their own references (elastic_plan is
+    # the scripted {step: members} membership plan bench/tests drive)
+    skip = {"model", "data", "rules", "mesh_shape", "on_metrics",
+            "elastic_plan"}
     lines = ["", "## Trainer (`FitConfig`, Python API)", "",
              "Set on `fit(FitConfig(...))` in the training script; these are "
              "not job-file keys. `model` (LlamaConfig), `data` (DataConfig "

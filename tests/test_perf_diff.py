@@ -84,6 +84,12 @@ class TestVerdict:
         assert "extra.step_anatomy.overlap_frac" in keys
         assert "extra.step_anatomy.exposed_collective_ms" in keys
         assert "extra.step_anatomy.top_collective.achieved_gbps" in keys
+        # the elastic section gates too: warm-restart cost (both the
+        # journal number and the trace-goodput one) and the post-shrink
+        # step-time ratio all flag
+        assert "extra.elastic.restart_s" in keys
+        assert "extra.elastic.goodput.restart_s" in keys
+        assert "extra.elastic.shrunk_step_ratio" in keys
         # within-tolerance drift is NOT flagged
         assert "extra.loss" not in keys          # +0.04% << 2%
         assert "extra.peak_hbm_gb" not in keys   # +1.5% << 10%

@@ -106,6 +106,25 @@ class ApplicationMaster(ApplicationRpcServicer):
                 "(max_worker_restarts %d): a lost decode host relaunches "
                 "alone while survivors keep serving", self._max_restarts,
             )
+        # elastic training (tony_tpu/elastic/, docs/ELASTIC.md): on a lost
+        # member the AM declares a new cluster generation instead of
+        # cold-restarting the gang; auto-enabled for framework "elastic"
+        self._elastic_enabled = (
+            config.get_bool(Keys.ELASTIC_ENABLED, False)
+            or config.get_str(Keys.APPLICATION_FRAMEWORK) == "elastic"
+        )
+        self._elastic_min_members = config.get_int(Keys.ELASTIC_MIN_MEMBERS, 1)
+        self._elastic_grow_back = config.get_bool(Keys.ELASTIC_GROW_BACK, True)
+        self._elastic_grow_retry_s = config.get_float(
+            Keys.ELASTIC_GROW_RETRY_S, 2.0
+        )
+        # seats currently out of the membership: task_id -> member rank.
+        # Detached tasks sit PENDING but UNSCHEDULED until grow-back
+        # re-leases their capacity; _elastic_relaunching tracks the ones
+        # back in flight (their registration declares the grow generation)
+        self._elastic_detached: dict[str, int] = {}
+        self._elastic_relaunching: set[str] = set()
+        self._elastic_last_grow = 0.0
         self._latest_metrics: dict[str, dict[str, float]] = {}
         self._last_metrics_event: dict[str, float] = {}
         self._step_metric_seen: set[str] = set()
@@ -279,6 +298,12 @@ class ApplicationMaster(ApplicationRpcServicer):
             ready = self._fcfs_ready(request.job_name)
         else:
             ready = self.session.all_registered()
+            if not ready and self._elastic_enabled:
+                # a grown-back member polls while OTHER detached seats may
+                # still be empty: the barrier counts live seats only —
+                # detached tasks are out of the membership by declaration,
+                # not stragglers the gang should wait for
+                ready = self._elastic_ready()
         if not ready:
             return pb.GetClusterSpecResponse(ready=False)
         self.session.mark_running(request.job_name, request.index)
@@ -306,6 +331,252 @@ class ApplicationMaster(ApplicationRpcServicer):
             for n in names
             for t in self.session.tasks_of_type(n)
         )
+
+    # --- elastic membership (tony_tpu/elastic/protocol.py) -------------------
+
+    def _elastic_ready(self) -> bool:
+        with self.session.lock:
+            return all(
+                t.state not in (TaskState.PENDING, TaskState.ALLOCATED)
+                or t.task_id in self._elastic_detached
+                for t in self.session.tasks.values()
+            )
+
+    def _elastic_members_live(self) -> list[int]:
+        """Current membership: every tracked seat not detached."""
+        ranks = self.session.rank_table()
+        return sorted(
+            rank for tid, rank in ranks.items()
+            if tid not in self._elastic_detached
+        )
+
+    def _elastic_declare(self, boundary: str, *, dead: list[int] = (),
+                         added: list[int] = (), reason: str = "",
+                         freed_host: str = "", granted_host: str = "") -> None:
+        """Declare a new cluster generation: bump the session generation
+        (the same monotonic counter gang restarts use — the
+        generation-monotonic invariant covers both) and broadcast the
+        membership over the shared app dir; survivors fence on it."""
+        from tony_tpu.elastic.protocol import GenerationRecord, write_generation
+
+        with self.session.lock:
+            if boundary != "start":
+                self.session.generation += 1
+            generation = self.session.generation
+        members = self._elastic_members_live()
+        rec = GenerationRecord(
+            generation=generation, members=tuple(members), boundary=boundary,
+            dead=tuple(dead), added=tuple(added), reason=reason,
+            freed_host=freed_host, granted_host=granted_host,
+        )
+        write_generation(self.app_dir, rec)
+        event = (
+            EventType.ELASTIC_GROW if boundary == "grow"
+            else EventType.ELASTIC_SHRINK
+        )
+        if boundary != "start":
+            self.events.emit(
+                event, generation=generation, members=members,
+                dead=list(dead), added=list(added), reason=reason,
+                freed_host=freed_host, granted_host=granted_host,
+            )
+        members_str = ",".join(str(m) for m in members)
+        trace.instant(
+            f"am.elastic_{boundary}", generation=generation,
+            members=members_str,
+        )
+        log.warning(
+            "elastic generation %d (%s): members=%s dead=%s added=%s",
+            generation, boundary, members, list(dead), list(added),
+        )
+
+    def _elastic_detach(self, failed: list) -> list:
+        """Handle lost members elastically; returns the tasks the normal
+        failure policy must still judge (empty when fully absorbed).
+
+        Falls back — whole, never partially — when the coordinator
+        (member 0, the trainer) is among the dead or the survivors would
+        drop below elastic.min_members: those cases need the cold
+        restart.policy path (checkpoint resume), not a reshard.
+        """
+        ranks = self.session.rank_table()
+        relaunch_failures = [
+            t for t in failed if t.task_id in self._elastic_relaunching
+        ]
+        fresh = [t for t in failed if t.task_id not in self._elastic_relaunching]
+        # a relaunch that died before its grow generation was declared
+        # goes quietly back to detached — membership never included it,
+        # and its grow lease is RETURNED (the next attempt grows again;
+        # without the return a crash-looping relaunch leaks one lease
+        # per retry until the store has nothing left to grant)
+        for t in relaunch_failures:
+            self._elastic_relaunching.discard(t.task_id)
+            self._elastic_return_lease(t)
+            self._requeue_detached(t)
+            log.warning(
+                "elastic relaunch of %s failed before rejoining; seat "
+                "stays detached", t.task_id,
+            )
+        if not fresh:
+            return []
+        victims = [t for t in fresh if t.task_id in ranks]
+        if any(ranks[t.task_id] == 0 for t in victims):
+            return failed  # trainer lost: cold path
+        live_after = [
+            r for tid, r in ranks.items()
+            if tid not in self._elastic_detached
+            and tid not in {t.task_id for t in victims}
+        ]
+        if len(live_after) < max(self._elastic_min_members, 1):
+            log.warning(
+                "elastic shrink would leave %d member(s) < min_members %d; "
+                "falling back to restart policy",
+                len(live_after), self._elastic_min_members,
+            )
+            return failed
+        dead_members = sorted(ranks[t.task_id] for t in victims)
+        freed_hosts = []
+        for t in victims:
+            dead_host = t.host  # cleared by the requeue below
+            self._elastic_detached[t.task_id] = ranks[t.task_id]
+            self._requeue_detached(t)
+            shrink = getattr(self.backend, "shrink_job_lease", None)
+            if shrink is not None:
+                spec = self.specs[t.job_name]
+                freed = shrink(
+                    Resource(spec.memory_mb, spec.cpus, spec.tpu_chips),
+                    host=dead_host,
+                )
+                if freed:
+                    freed_hosts.append(freed)
+        self._elastic_declare(
+            "shrink", dead=dead_members,
+            reason="; ".join(sorted(t.task_id for t in victims)),
+            freed_host=",".join(freed_hosts),
+        )
+        self._write_am_state()
+        return [t for t in fresh if t not in victims]
+
+    def _elastic_return_lease(self, t) -> None:
+        """Hand back the lease a failed relaunch was granted (grow-back
+        took one per attempt; the seat's next attempt grows afresh)."""
+        shrink = getattr(self.backend, "shrink_job_lease", None)
+        if shrink is None:
+            return
+        spec = self.specs[t.job_name]
+        shrink(
+            Resource(spec.memory_mb, spec.cpus, spec.tpu_chips), host=t.host
+        )
+
+    def _requeue_detached(self, t) -> None:
+        """Reset a detached seat to PENDING-but-unscheduled: the attempt
+        bump is the heartbeat fence (a surviving ghost of this member gets
+        ABORT on its next beat), and the container release reaps the
+        process group. Grow-back re-schedules it later."""
+        with self.session.lock:
+            cid = t.container_id
+            t.state = TaskState.PENDING
+            t.host, t.port = "", 0
+            t.container_id = ""
+            t.container_pid = 0
+            t.exit_code = None
+            t.attempt += 1
+            t.last_heartbeat = 0.0
+        if cid:
+            self.backend.release(cid)
+
+    def _elastic_tick(self) -> None:
+        """Per-supervision-tick elastic upkeep: declare grow generations
+        for relaunched members that registered, and retry capacity for
+        detached seats (throttled)."""
+        if not self._elastic_enabled:
+            return
+        # relaunched member back at the barrier -> it rejoins the
+        # membership at the next generation boundary
+        for tid in sorted(self._elastic_relaunching):
+            t = self.session.tasks.get(tid)
+            if t is None or t.state in (TaskState.PENDING, TaskState.ALLOCATED):
+                continue
+            if t.state in TERMINAL:
+                # the relaunch died (or exited) before rejoining: the seat
+                # goes back to detached — with its grow lease returned —
+                # and the next tick tries again; it must not strand
+                # half-promoted or leak a lease per retry
+                self._elastic_relaunching.discard(tid)
+                self._elastic_return_lease(t)
+                self._requeue_detached(t)
+                continue
+            member = self._elastic_detached.pop(tid, None)
+            self._elastic_relaunching.discard(tid)
+            if member is None:
+                continue
+            self._elastic_declare(
+                "grow", added=[member], reason=tid, granted_host=t.host,
+            )
+            self._write_am_state()
+        # grow-back: re-lease capacity for seats still out
+        if not self._elastic_grow_back:
+            return
+        waiting = [
+            tid for tid in sorted(self._elastic_detached)
+            if tid not in self._elastic_relaunching
+        ]
+        if not waiting:
+            return
+        now = time.monotonic()
+        if now - self._elastic_last_grow < self._elastic_grow_retry_s:
+            return
+        self._elastic_last_grow = now
+        grow = getattr(self.backend, "grow_job_lease", None)
+        to_schedule = []
+        for tid in waiting:
+            t = self.session.tasks.get(tid)
+            if t is None:
+                continue
+            if grow is not None:
+                spec = self.specs[t.job_name]
+                granted = grow(Resource(spec.memory_mb, spec.cpus, spec.tpu_chips))
+                if granted is None:
+                    log.info(
+                        "elastic grow-back: no capacity for %s yet", tid
+                    )
+                    continue
+            to_schedule.append(tid)
+        if not to_schedule:
+            return
+        tasks_str = ",".join(to_schedule)
+        log.warning("elastic grow-back: relaunching %s", tasks_str)
+        trace.instant("am.elastic_relaunch", tasks=tasks_str)
+        for tid in to_schedule:
+            self._elastic_relaunch(tid)
+
+    def _elastic_relaunch(self, tid: str) -> None:
+        """Directly allocate ONE detached seat's container (the scheduler's
+        schedule_all blocks until NO task is pending, which would wedge on
+        sibling seats still waiting for capacity). Dependencies are moot —
+        the gang is already running."""
+        t = self.session.tasks.get(tid)
+        if t is None:
+            return
+        spec = self.specs[t.job_name]
+        req = self._make_request(spec, t.index)
+        try:
+            container = self.backend.allocate(req)
+        except Exception:
+            log.warning("elastic relaunch allocate failed for %s", tid,
+                        exc_info=True)
+            # hand the freshly-grown lease back; the next tick retries
+            shrink = getattr(self.backend, "shrink_job_lease", None)
+            if shrink is not None:
+                shrink(Resource(spec.memory_mb, spec.cpus, spec.tpu_chips))
+            return
+        with self.session.lock:
+            t.state = TaskState.ALLOCATED
+            t.container_id = container.container_id
+            t.host = container.host
+            t.started_at = time.time()
+        self._elastic_relaunching.add(tid)
+        self._on_allocated(t.job_name, t.index, container, req.log_path)
 
     def Heartbeat(self, request, context):  # noqa: N802
         alive = self.session.touch(request.job_name, request.index, request.attempt)
@@ -651,6 +922,10 @@ class ApplicationMaster(ApplicationRpcServicer):
             with trace.span("am.schedule", parent=self._run_span.sid or None,
                             generation=self.session.generation):
                 self.scheduler.schedule_all(self.specs)
+            if self._elastic_enabled:
+                # baseline membership declaration: the record survivors'
+                # journals and the post-mortem measure boundaries against
+                self._elastic_declare("start")
             self._supervise(deadline)
         except Exception as e:
             log.exception("AM failed")
@@ -749,6 +1024,9 @@ class ApplicationMaster(ApplicationRpcServicer):
                 if task is not None and task.container_id == cid and task.state not in TERMINAL:
                     self._finish_task(job_name, index, code, pid_dead=authoritative)
             self._check_heartbeats()
+            # elastic upkeep: declare grow generations for members back at
+            # the barrier, retry capacity for detached seats (throttled)
+            self._elastic_tick()
             # Fence when the lease keeper says our leases are GONE, or
             # when it has been silently stuck (hung store) past the TTL:
             # either way survivors may re-lease the chips this job is
@@ -843,13 +1121,26 @@ class ApplicationMaster(ApplicationRpcServicer):
         failed = self.session.failed_tasks()
         if not failed:
             return False
+        if self._elastic_enabled:
+            # elastic-first: a lost member becomes a shrink generation, not
+            # a restart — survivors keep training from in-memory state.
+            # Whatever elastic cannot absorb (lost trainer, below
+            # min_members) falls through to the cold policy below, whole.
+            failed = self._elastic_detach(failed)
+            if not failed:
+                return False
         # chief semantics: a finished chief ends the job regardless of policy
+        # — EXCEPT in an elastic job with a restart policy: there the chief
+        # IS the trainer, the host most likely to be preempted, and the
+        # documented fallback for losing it is the cold restart.policy path
+        # (checkpoint resume), not a hard failure (docs/ELASTIC.md)
         if self.session.chief_type and any(
             t.job_name == self.session.chief_type for t in failed
         ):
-            self.session.state = JobState.FAILED
-            self.session.diagnostics = "chief failed"
-            return True
+            if not (self._elastic_enabled and self._restart_policy != "never"):
+                self.session.state = JobState.FAILED
+                self.session.diagnostics = "chief failed"
+                return True
         if self._restart_policy == "never":
             self.session.state = JobState.FAILED
             self.session.diagnostics = (
@@ -898,6 +1189,17 @@ class ApplicationMaster(ApplicationRpcServicer):
             self.session.reset_for_restart(None)
             if self._rendezvous is not None:
                 self._rendezvous.clear()  # stale peer info must 404 after restart
+            if self._elastic_enabled:
+                # the cold path supersedes elastic bookkeeping: every seat
+                # relaunches below, so nothing is detached any more — a
+                # stale entry would double-allocate the seat on the next
+                # grow tick AND exclude a live member from every future
+                # generation. Declare a fresh full-membership baseline at
+                # the restarted generation so relaunched trainers don't
+                # fence on the pre-restart shrink record.
+                self._elastic_detached.clear()
+                self._elastic_relaunching.clear()
+                self._elastic_declare("start", reason="gang restart")
             self._write_am_state()
             self._drain_notifications()
             self.scheduler.schedule_all(self.specs)

@@ -26,6 +26,10 @@ class EventType:
     TASK_REGISTERED = "TASK_REGISTERED"
     TASK_FINISHED = "TASK_FINISHED"
     GANG_RESTART = "GANG_RESTART"
+    # elastic membership boundaries (docs/ELASTIC.md): the AM declared a
+    # new cluster generation instead of cold-restarting the gang
+    ELASTIC_SHRINK = "ELASTIC_SHRINK"
+    ELASTIC_GROW = "ELASTIC_GROW"
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
     METADATA = "METADATA"
     METRICS = "METRICS"

@@ -35,6 +35,12 @@ the guarantees the module docstrings promise in prose:
     Per host, the sum of all apps' leased resources never exceeds the
     host's registered capacity — two owners can never hold the same slot.
 
+``lease-events-audit``
+    The store's grow/shrink event log (elastic grow-back, serve
+    autoscale) is well-formed: every event names its op, owner, app and a
+    registered host, in order — the audit trail that makes a capacity
+    change attributable after the fact.
+
 ``health-verdict-surfaced``
     A job whose numerics sentinel tripped (obs/health.py wrote a
     ``tripped`` verdict under ``<app_dir>/health/``) must not report
@@ -65,6 +71,23 @@ the guarantees the module docstrings promise in prose:
     When the ledger records a TTFT contract (``serve.gang.ttft_budget_s``
     > 0), no completed request's time-to-first-token exceeded it — the
     bounded-TTFT-under-kill serving contract.
+
+``elastic-no-data-loss``
+    Over every elastic journal (``<app_dir>/elastic/journal_m*.jsonl``,
+    docs/ELASTIC.md): the consumed step sequence is contiguous (no batch
+    repeated, none skipped), membership changes only at declared reshard
+    boundaries, every gap in a member's participation is exactly covered
+    by a declared skip range, and no two consecutive recorded batch
+    fingerprints repeat — the machine-checkable form of "the stream
+    skipped exactly the dead host's unconsumed batches".
+
+``elastic-loss-continuity``
+    At every reshard boundary the post-boundary losses stay within the
+    journal's declared tolerance of the pre-boundary window (mean +
+    max(z·std, frac·|mean|)) and remain finite: survivors continued the
+    SAME training run from in-memory state, not a degraded restart. The
+    tolerance is read from the journal's meta record — the post-mortem
+    judges by the contract the trainer declared, never one it invents.
 
 The checker reads the store's ``state.json`` RAW (no LeaseStore handle):
 going through the store would run its reapers and destroy the evidence.
@@ -246,7 +269,152 @@ def _check_job(app_dir: str, report: InvariantReport) -> tuple[str, str]:
             )
         )
     _check_serve_ledgers(app_dir, app_id, report)
+    _check_elastic(app_dir, app_id, report)
     return app_id, state
+
+
+def _member_gaps(steps: list[dict], member: int) -> list[tuple[int, int]]:
+    """[from, to) step ranges inside the journal where ``member`` was NOT
+    in the membership (the intervals a skip declaration must cover)."""
+    gaps: list[tuple[int, int]] = []
+    start = None
+    for rec in steps:
+        absent = member not in rec.get("members", [])
+        if absent and start is None:
+            start = rec["step"]
+        elif not absent and start is not None:
+            gaps.append((start, rec["step"]))
+            start = None
+    if start is not None:
+        gaps.append((start, steps[-1]["step"] + 1))
+    return gaps
+
+
+def _check_elastic(app_dir: str, app_id: str, report: InvariantReport) -> None:
+    """Audit the elastic trainer journals: no data repeated or lost across
+    generation boundaries, loss trajectory continuous through them."""
+    from tony_tpu.elastic.protocol import (
+        DEFAULT_TOLERANCE, journal_files, read_journal,
+    )
+
+    for path in journal_files(app_dir):
+        recs = read_journal(path)
+        subject = f"{app_id}/{os.path.basename(path)}"
+        meta = next((r for r in recs if r.get("type") == "meta"), {})
+        tol = {**DEFAULT_TOLERANCE, **(meta.get("tolerance") or {})}
+        steps = [r for r in recs if r.get("type") == "step"]
+        reshards = [r for r in recs if r.get("type") == "reshard"]
+        losses = [r for r in recs if r.get("type") == "loss"]
+        if not steps:
+            continue
+
+        # --- elastic-no-data-loss ------------------------------------------
+        for a, b in zip(steps, steps[1:]):
+            if b["step"] == a["step"] + 1:
+                continue
+            what = "repeated" if b["step"] <= a["step"] else "skipped"
+            report.violations.append(
+                Violation(
+                    "elastic-no-data-loss", subject,
+                    f"step sequence {what} data: {a['step']} -> {b['step']}",
+                )
+            )
+            break
+        boundaries = {r.get("at_step") for r in reshards}
+        for a, b in zip(steps, steps[1:]):
+            if (set(a.get("members", [])) != set(b.get("members", []))
+                    and b["step"] not in boundaries):
+                report.violations.append(
+                    Violation(
+                        "elastic-no-data-loss", subject,
+                        f"membership changed {a.get('members')} -> "
+                        f"{b.get('members')} at step {b['step']} without a "
+                        "declared reshard boundary",
+                    )
+                )
+                break
+        # every member's absence must be exactly a declared skip range
+        # (open ranges -1 close at the journal's end)
+        # a shrink declares an OPEN range ([from, -1]); the matching grow
+        # re-declares it closed with the same start — journal order wins,
+        # and a still-open range closes at the journal's end
+        declared: dict[int, dict[int, int]] = {}
+        end_step = steps[-1]["step"] + 1
+        for r in reshards:
+            for m, rng in (r.get("skipped") or {}).items():
+                declared.setdefault(int(m), {})[int(rng[0])] = int(rng[1])
+        members_seen = {m for rec in steps for m in rec.get("members", [])}
+        members_seen |= set(declared)
+        for m in sorted(members_seen):
+            gaps = _member_gaps(steps, m)
+            merged = sorted(
+                (lo, end_step if hi < 0 else min(hi, end_step))
+                for lo, hi in declared.get(m, {}).items()
+                if lo < end_step
+            )
+            if gaps != merged:
+                report.violations.append(
+                    Violation(
+                        "elastic-no-data-loss", subject,
+                        f"member {m}: journal gaps {gaps} != declared "
+                        f"skip ranges {merged} — data silently lost or "
+                        "skipped without declaration",
+                    )
+                )
+        fps = [(r["step"], r["fp"]) for r in losses if "fp" in r]
+        for (s0, f0), (s1, f1) in zip(fps, fps[1:]):
+            if f0 == f1:
+                report.violations.append(
+                    Violation(
+                        "elastic-no-data-loss", subject,
+                        f"batch fingerprint repeated across steps {s0} -> "
+                        f"{s1} (fp={f1}): the stream replayed data",
+                    )
+                )
+                break
+
+        # --- elastic-loss-continuity ---------------------------------------
+        window = int(tol.get("window", 8))
+        for r in reshards:
+            at = r.get("at_step", 0)
+            before = [x["loss"] for x in losses if x["step"] < at][-window:]
+            after = [x["loss"] for x in losses if x["step"] >= at]
+            after = after[: max(window // 2, 1)]
+            if not before or not after:
+                report.notes.append(
+                    f"{subject}: reshard at step {at} has too few recorded "
+                    "losses to judge continuity"
+                )
+                continue
+            if any(x != x or x in (float("inf"), float("-inf")) for x in after):
+                report.violations.append(
+                    Violation(
+                        "elastic-loss-continuity", subject,
+                        f"non-finite loss after the generation boundary at "
+                        f"step {at}",
+                    )
+                )
+                continue
+            mean_b = sum(before) / len(before)
+            var = (
+                sum((x - mean_b) ** 2 for x in before) / (len(before) - 1)
+                if len(before) > 1 else 0.0
+            )
+            bound = mean_b + max(
+                float(tol.get("z", 4.0)) * var ** 0.5,
+                float(tol.get("frac", 0.25)) * abs(mean_b),
+            )
+            mean_a = sum(after) / len(after)
+            if mean_a > bound:
+                report.violations.append(
+                    Violation(
+                        "elastic-loss-continuity", subject,
+                        f"loss discontinuity at the generation boundary "
+                        f"(step {at}): post-boundary mean {mean_a:.4f} "
+                        f"exceeds the declared tolerance bound {bound:.4f} "
+                        f"(pre-boundary mean {mean_b:.4f})",
+                    )
+                )
 
 
 def _check_serve_ledgers(app_dir: str, app_id: str, report: InvariantReport) -> None:
@@ -372,7 +540,29 @@ def _check_store(rm_root: str, terminal_apps: dict[str, str], report: InvariantR
                 )
             )
 
+    # grow/shrink audit trail (LeaseStore._emit_event): every elastic /
+    # autoscale capacity change must name an owner and a registered host,
+    # in order — an event that fails this is a store whose accounting can
+    # no longer be trusted by the double-book check below
     hosts = store.get("hosts", {})
+    last_ts = 0.0
+    for i, ev in enumerate(store.get("events", [])):
+        what = ""
+        if not isinstance(ev, dict) or ev.get("op") not in ("grow", "shrink"):
+            what = f"malformed op {ev!r}"
+        elif not ev.get("app_id") or not ev.get("owner"):
+            what = "missing app_id/owner attribution"
+        elif ev.get("host") not in hosts:
+            what = f"unregistered host {ev.get('host')!r}"
+        elif float(ev.get("ts", 0) or 0) + 1.0 < last_ts:
+            what = "events out of order"
+        if what:
+            report.violations.append(
+                Violation("lease-events-audit", rm_root, f"event[{i}]: {what}")
+            )
+            break
+        last_ts = max(last_ts, float(ev.get("ts", 0) or 0))
+
     leased: dict[str, list[int]] = {h: [0, 0, 0] for h in hosts}
     for app_id, app in store.get("apps", {}).items():
         for gang in app.get("gangs", []):

@@ -376,6 +376,43 @@ def cmd_health(args: argparse.Namespace) -> int:
     return 0 if roll["verdict"] == "healthy" else 1
 
 
+def cmd_elastic(args: argparse.Namespace) -> int:
+    """Audit an application's elastic membership history (docs/ELASTIC.md):
+    every declared generation, each trainer journal's reshard boundaries
+    with their skipped data ranges, and the current membership. Exit 0 =
+    history present, 2 = the job never declared a generation (not an
+    elastic job, or it died before the start record)."""
+    from tony_tpu.elastic.protocol import (
+        journal_files, read_history, read_journal,
+    )
+
+    app_dir = resolve_app_dir(args.app)
+    history = read_history(app_dir)
+    if not history:
+        print(
+            f"no elastic generations under {os.path.join(app_dir, 'elastic')} "
+            "(not an elastic job?)",
+            file=sys.stderr,
+        )
+        return 2
+    out = {
+        "generations": [r.to_dict() for r in history],
+        "current": history[-1].to_dict(),
+        "journals": {},
+    }
+    for path in journal_files(app_dir):
+        recs = read_journal(path)
+        steps = [r for r in recs if r.get("type") == "step"]
+        out["journals"][os.path.basename(path)] = {
+            "steps": len(steps),
+            "first_step": steps[0]["step"] if steps else None,
+            "last_step": steps[-1]["step"] if steps else None,
+            "reshards": [r for r in recs if r.get("type") == "reshard"],
+        }
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_profile(args: argparse.Namespace) -> int:
     """``tony profile <app_id> [--steps N | --seconds T]``: ask the AM to
     broadcast a bounded capture window to every process of the job, wait
@@ -653,6 +690,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="inline the forensics bundle contents into the report",
     )
     s.set_defaults(fn=cmd_health)
+
+    s = sub.add_parser(
+        "elastic",
+        help="audit an app's elastic membership history: generations, "
+             "reshard boundaries, skipped data ranges (docs/ELASTIC.md)",
+    )
+    s.add_argument("app", help="application id or app-dir path")
+    s.set_defaults(fn=cmd_elastic)
 
     s = sub.add_parser(
         "profile",

@@ -679,7 +679,22 @@ class LeaseStore:
             ]
         return True
 
-    # --- autoscale hooks (gang serving; serve/frontend.py) ------------------
+    # --- autoscale / elastic hooks (serve/frontend.py, am elastic path) -----
+
+    @staticmethod
+    def _emit_event(state: dict, op: str, app_id: str, gang_id: str,
+                    host: str, owner: str) -> None:
+        """Append one grow/shrink record to the store's bounded event log
+        — the audit trail the chaos invariant checker replays
+        (``lease-events-audit``): every elastic/autoscale capacity change
+        must be attributable to an owner and a registered host."""
+        ev = state.setdefault("events", [])
+        ev.append({
+            "ts": time.time(), "op": op, "app_id": app_id,
+            "gang_id": gang_id, "host": host, "owner": owner,
+        })
+        if len(ev) > 512:
+            del ev[: len(ev) - 512]
 
     def grow_gang(self, app_id: str, gang_id: str, ask: GangAsk) -> str | None:
         """Append ONE ask to an existing (or new) gang reservation if it
@@ -714,14 +729,25 @@ class LeaseStore:
                     state, app_id, gang_id, [ask.to_json()], packing,
                     self._owner_host,
                 )
+            self._emit_event(
+                state, "grow", app_id, gang_id, packing[0],
+                f"{self._owner_host}:{os.getpid()}",
+            )
             return packing[0]
 
-    def shrink_gang(self, app_id: str, gang_id: str) -> str | None:
-        """Drop the LAST ask of a gang reservation (the shrink hook:
-        sustained idle queue hands a host's capacity back to the cluster
-        BEFORE job end). Returns the freed host, or None when the gang
-        has nothing to shrink. An emptied gang is removed like
+    def shrink_gang(self, app_id: str, gang_id: str,
+                    ask: GangAsk | None = None,
+                    host: str = "") -> str | None:
+        """Drop one ask of a gang reservation and return its host: the
+        LAST ask by default (the serve-autoscale shrink), or — with
+        ``ask``/``host`` given — the last entry matching both (the
+        elastic path hands back the dead member's REAL container lease;
+        in a homogeneous gang the ask value alone cannot identify WHICH
+        member's lease is being returned, so callers that know the dead
+        host must pin it or the freed host may be a survivor's). Returns
+        None when nothing matches. An emptied gang is removed like
         release_gang would."""
+        want = ask.to_json() if ask is not None else None
         with self._locked() as state:
             app = state["apps"].get(app_id)
             if app is None:
@@ -733,16 +759,30 @@ class LeaseStore:
                 )
                 return None
             for gang in app["gangs"]:
-                if gang["gang_id"] == gang_id and gang["asks"]:
-                    gang["asks"].pop()
-                    freed = gang["hosts"].pop()
-                    if not gang["asks"]:
-                        app["gangs"] = [
-                            g for g in app["gangs"] if g["gang_id"] != gang_id
-                        ]
-                        if not app["gangs"]:
-                            state["apps"].pop(app_id, None)
-                    return freed
+                if gang["gang_id"] != gang_id or not gang["asks"]:
+                    continue
+                idx = len(gang["asks"]) - 1
+                if want is not None or host:
+                    while idx >= 0 and not (
+                        (want is None or gang["asks"][idx] == want)
+                        and (not host or gang["hosts"][idx] == host)
+                    ):
+                        idx -= 1
+                    if idx < 0:
+                        return None
+                gang["asks"].pop(idx)
+                freed = gang["hosts"].pop(idx)
+                if not gang["asks"]:
+                    app["gangs"] = [
+                        g for g in app["gangs"] if g["gang_id"] != gang_id
+                    ]
+                    if not app["gangs"]:
+                        state["apps"].pop(app_id, None)
+                self._emit_event(
+                    state, "shrink", app_id, gang_id, freed,
+                    f"{self._owner_host}:{os.getpid()}",
+                )
+                return freed
             return None
 
     def release_gang(self, app_id: str, gang_id: str) -> bool:

@@ -167,6 +167,48 @@ class LocalProcessBackend(_InventoryMixin, _LeaseRenewalMixin):
                 self._release_ondemand(gid, r)
             raise
 
+    def shrink_job_lease(self, r: Resource, host: str = "") -> str | None:
+        """Elastic shrink: hand a dead member's container lease back to
+        the shared RM so other jobs can use the freed capacity while this
+        one runs shrunk. Returns the freed host, None when the store
+        refused (nothing matching / foreign owner), or "" without a store
+        (per-job inventory: nothing to hand back). The job budget narrows
+        with the lease so a later allocate cannot consume capacity the
+        store may have re-granted elsewhere. ``host`` is accepted for
+        interface parity with multi-host backends; every lease here is on
+        this host anyway."""
+        if self._store is None:
+            return ""
+        from tony_tpu.cluster.lease import GangAsk
+
+        freed = self._store.shrink_gang(
+            self._app_id, "containers", ask=GangAsk(r, host=local_host()),
+            host=local_host(),
+        )
+        if freed is not None:
+            with self._inv_lock:
+                self._job_budget = self._job_budget - r
+        return freed
+
+    def grow_job_lease(self, r: Resource) -> str | None:
+        """Elastic grow-back: re-lease one container-sized ask — the
+        gang's REAL GangAsk, so the relaunched member's chips are
+        arbitrated exactly like the original reservation (a hardcoded
+        token ask would double-book). Returns the granted host, None when
+        no capacity is free right now (the AM retries on its cadence), or
+        "" without a store."""
+        if self._store is None:
+            return ""
+        from tony_tpu.cluster.lease import GangAsk
+
+        host = self._store.grow_gang(
+            self._app_id, "containers", GangAsk(r, host=local_host())
+        )
+        if host is not None:
+            with self._inv_lock:
+                self._job_budget = self._job_budget + r
+        return host
+
     def am_advertise_host(self) -> str:
         # Containers are subprocesses on this host; loopback is correct.
         return "127.0.0.1"

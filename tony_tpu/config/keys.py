@@ -40,6 +40,28 @@ class Keys:
     RESTART_POLICY = "restart.policy"  # never | failed_only | gang
     RESTART_RESUME_FROM_CHECKPOINT = "restart.resume_from_checkpoint"
 
+    # --- elastic training (tony_tpu/elastic/; docs/ELASTIC.md) ---
+    # survive preemption without a cold restart: on a lost training host
+    # the AM declares a new cluster generation (members minus the dead
+    # host) instead of gang-restarting; the trainer reshards its dp axis
+    # and continues from the in-memory state of survivors. Auto-enabled
+    # for application.framework = "elastic" jobs.
+    ELASTIC_ENABLED = "elastic.enabled"
+    # smallest surviving membership the job may shrink to; fewer survivors
+    # (or a lost coordinator) falls back to the restart.policy cold path
+    ELASTIC_MIN_MEMBERS = "elastic.min_members"
+    # re-acquire capacity and restore dead members automatically (the
+    # grow-back half; LeaseStore.grow_gang re-leases the REAL container ask)
+    ELASTIC_GROW_BACK = "elastic.grow_back"
+    # how often the AM retries capacity for a dead member (seconds)
+    ELASTIC_GROW_RETRY_S = "elastic.grow_retry_s"
+    # trainer-side knobs, exported AM -> executor -> user process:
+    # how often the trainer polls the generation broadcast file
+    ELASTIC_POLL_S = "elastic.poll_interval_s"
+    # async device->host checkpoint-shadow stride (steps); the shadow is
+    # the bounded-lag fallback recovery point (the fence capture is exact)
+    ELASTIC_SHADOW_STEPS = "elastic.shadow_interval_steps"
+
     # --- distributed mode ---
     SCHEDULER_MODE = "scheduler.mode"  # GANG | FCFS (SURVEY.md: TaskScheduler modes)
 
@@ -272,6 +294,12 @@ DEFAULTS: dict[str, object] = {
     Keys.RESTART_MAX_WORKER_RESTARTS: 0,
     Keys.RESTART_POLICY: "never",
     Keys.RESTART_RESUME_FROM_CHECKPOINT: True,
+    Keys.ELASTIC_ENABLED: False,
+    Keys.ELASTIC_MIN_MEMBERS: 1,
+    Keys.ELASTIC_GROW_BACK: True,
+    Keys.ELASTIC_GROW_RETRY_S: 2.0,
+    Keys.ELASTIC_POLL_S: 0.5,
+    Keys.ELASTIC_SHADOW_STEPS: 16,
     Keys.SCHEDULER_MODE: "GANG",
     Keys.CHECKPOINT_DIR: "",
     Keys.CHECKPOINT_INTERVAL_STEPS: 0,

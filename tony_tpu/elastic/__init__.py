@@ -1,0 +1,88 @@
+"""Elastic training: survive TPU preemption without a cold restart.
+
+The subsystem behind ``elastic.*`` config (docs/ELASTIC.md): on a lost
+training host the AM declares a new cluster generation instead of
+gang-restarting; survivors fence on it, reshard the dp axis via the
+runtime-swappable :class:`ElasticTopology`, donate state from the
+host-RAM :class:`ShadowStore`, skip exactly the dead member's unconsumed
+batches (:class:`ElasticBatchStream`), and keep stepping — then grow back
+when the lease store re-acquires capacity.
+
+The protocol layer (generation records, controller, journals) is
+stdlib-only so the AM and the invariant checker import it without paying
+for jax; the device-side pieces (topology/shadow/data) load lazily.
+"""
+
+from tony_tpu.elastic.protocol import (
+    ENV_ENABLED,
+    ENV_MEMBER,
+    ENV_MEMBERS,
+    ENV_POLL,
+    ENV_SHADOW,
+    ElasticController,
+    ElasticJournal,
+    ElasticSettings,
+    GenerationRecord,
+    active_controller,
+    elastic_dir,
+    generation_path,
+    install,
+    install_from_env,
+    journal_files,
+    journal_path,
+    read_generation,
+    read_history,
+    read_journal,
+    uninstall,
+    write_generation,
+)
+
+_LAZY = {
+    "ElasticBatchStream": ("tony_tpu.elastic.data", "ElasticBatchStream"),
+    "reference_batches": ("tony_tpu.elastic.data", "reference_batches"),
+    "ShadowStore": ("tony_tpu.elastic.shadow", "ShadowStore"),
+    "reshard_state": ("tony_tpu.elastic.shadow", "reshard_state"),
+    "ElasticTopology": ("tony_tpu.elastic.topology", "ElasticTopology"),
+}
+
+
+def __getattr__(name: str):
+    # lazy jax-side exports: the AM/checker import this package for the
+    # protocol alone and must not drag jax into a control-plane process
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+__all__ = [
+    "ENV_ENABLED",
+    "ENV_MEMBER",
+    "ENV_MEMBERS",
+    "ENV_POLL",
+    "ENV_SHADOW",
+    "ElasticBatchStream",
+    "ElasticController",
+    "ElasticJournal",
+    "ElasticSettings",
+    "ElasticTopology",
+    "GenerationRecord",
+    "ShadowStore",
+    "active_controller",
+    "elastic_dir",
+    "generation_path",
+    "install",
+    "install_from_env",
+    "journal_files",
+    "journal_path",
+    "read_generation",
+    "read_history",
+    "read_journal",
+    "reference_batches",
+    "reshard_state",
+    "uninstall",
+    "write_generation",
+]

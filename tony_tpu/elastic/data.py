@@ -1,0 +1,174 @@
+"""Membership-aware batch streams: skip exactly the dead member's data.
+
+Elastic training changes the *shape* of the global batch at a generation
+boundary (dp shrinks with the membership), and the data contract across
+that boundary is strict: survivors must neither replay a batch they
+already consumed nor skip one of their own — only the dead member's
+unconsumed positions may drop out of the stream, and they must be
+declared, not silently lost (chaos invariant ``elastic-no-data-loss``;
+the health sentinel's repeated-batch fingerprint rule is the runtime twin
+for the replay half).
+
+The stream keeps the bookkeeping trivial to audit by construction: every
+member draws its per-step shard from its OWN deterministic substream
+keyed by ``(seed + member, step)``, so a member's stream position is
+always exactly the global step index. Shrink/grow then never moves any
+survivor's position — membership just selects which substreams contribute
+to the global batch — and the skipped ranges are pure intervals
+``[shrink_step, grow_step)`` per dead member.
+
+``prefetch`` composes: the live membership's generator is wrapped in the
+standard :class:`~tony_tpu.train.prefetch.PrefetchIterator`; a reshard
+closes it and rebuilds from the boundary step. Batches the old prefetcher
+had generated but the loop never consumed are regenerated identically by
+the new one (same substreams, same positions) — discarding them is a
+re-layout, not a skip.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+from jax.sharding import NamedSharding
+
+from tony_tpu.train.data import Batch, DataConfig, _assemble
+
+
+class ElasticBatchStream:
+    """Synthetic per-member token stream for elastic ``fit()``.
+
+    ``cfg.global_batch`` is the FULL-membership global batch; each member
+    contributes ``global_batch / n_members`` rows. ``next()`` yields the
+    live membership's assembled (inputs, targets) pair; :meth:`reshard`
+    swaps membership + sharding at a step boundary and records what the
+    dead members will skip.
+    """
+
+    def __init__(self, cfg: DataConfig, n_members: int,
+                 members: tuple[int, ...],
+                 sharding: NamedSharding | None = None, start_step: int = 0,
+                 prefetch: int | None = None):
+        if cfg.path:
+            raise NotImplementedError(
+                "elastic fit currently streams the synthetic pipeline; "
+                "token-file streams need per-member shard ownership "
+                "(DataConfig.path with elastic_members is not supported yet)"
+            )
+        if n_members < 1 or cfg.global_batch % n_members:
+            raise ValueError(
+                f"global batch {cfg.global_batch} not divisible by "
+                f"{n_members} members"
+            )
+        self.cfg = cfg
+        self.n_members = n_members
+        self.per_member = cfg.global_batch // n_members
+        self.members: tuple[int, ...] = tuple(sorted(members))
+        self.step = start_step
+        self._sharding = sharding
+        self._prefetch = cfg.prefetch if prefetch is None else prefetch
+        # member -> [from_step, to_step) ranges this stream skipped; an
+        # open range (to_step == -1) means the member never came back
+        self.skipped: dict[int, list[list[int]]] = {}
+        self._cum = self._zipf_table(cfg.vocab_size)
+        self._it: Iterator[Batch] | None = None
+        self._rebuild()
+
+    @staticmethod
+    def _zipf_table(vocab_size: int) -> np.ndarray:
+        # same marginals as train.data.synthetic_batches (inverse-CDF over
+        # a one-time cumulative table; tail pinned so rounding can't
+        # index past vocab_size-1)
+        ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+        probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+        cum = np.cumsum(probs)
+        cum[-1] = 1.0
+        return cum
+
+    def member_rows(self, member: int, step: int) -> np.ndarray:
+        """Member ``member``'s [per_member, seq+1] token block at ``step``
+        — the deterministic substream contract (position == step)."""
+        rng = np.random.default_rng((self.cfg.seed + member, step))
+        draws = rng.random((self.per_member, self.cfg.seq_len + 1))
+        return np.searchsorted(self._cum, draws, side="right").astype(np.int32)
+
+    def _generate(self, members: tuple[int, ...], start: int) -> Iterator[Batch]:
+        step = start
+        while True:
+            tokens = np.concatenate(
+                [self.member_rows(m, step) for m in members], axis=0
+            )
+            step += 1
+            yield _assemble(
+                np.ascontiguousarray(tokens[:, :-1]),
+                np.ascontiguousarray(tokens[:, 1:]),
+                self._sharding,
+            )
+
+    def _rebuild(self) -> None:
+        it: Iterator[Batch] = self._generate(self.members, self.step)
+        if self._prefetch > 0:
+            from tony_tpu.train.prefetch import PrefetchIterator
+
+            it = PrefetchIterator(it, depth=self._prefetch)
+        self._it = it
+
+    def __iter__(self) -> "ElasticBatchStream":
+        return self
+
+    def __next__(self) -> Batch:
+        batch = next(self._it)
+        self.step += 1
+        return batch
+
+    @property
+    def global_batch(self) -> int:
+        """Live global batch rows (shrinks/grows with membership)."""
+        return self.per_member * len(self.members)
+
+    def reshard(self, members: tuple[int, ...],
+                sharding: NamedSharding | None) -> dict[int, tuple[int, int]]:
+        """Swap membership at the current step boundary.
+
+        Returns the skip bookkeeping delta: ``{member: (from, to)}`` —
+        a newly-dead member opens ``(step, -1)``; a returning member
+        closes its open range at ``(from, step)``. Survivor positions are
+        untouched by construction."""
+        from tony_tpu.train.prefetch import close_batches
+
+        members = tuple(sorted(members))
+        delta: dict[int, tuple[int, int]] = {}
+        for m in self.members:
+            if m not in members:
+                self.skipped.setdefault(m, []).append([self.step, -1])
+                delta[m] = (self.step, -1)
+        for m in members:
+            if m not in self.members:
+                ranges = self.skipped.get(m, [])
+                if ranges and ranges[-1][1] == -1:
+                    ranges[-1][1] = self.step
+                    delta[m] = (ranges[-1][0], self.step)
+        close_batches(self._it)
+        self.members = members
+        self._sharding = sharding
+        self._rebuild()
+        return delta
+
+    def close(self) -> None:
+        from tony_tpu.train.prefetch import close_batches
+
+        close_batches(self._it)
+
+
+def reference_batches(cfg: DataConfig, n_members: int,
+                      sharding: NamedSharding | None = None,
+                      start_step: int = 0) -> ElasticBatchStream:
+    """A full-membership elastic stream — the no-fault reference a
+    loss-continuity comparison trains against (same substreams, no
+    boundary)."""
+    return ElasticBatchStream(
+        cfg, n_members, tuple(range(n_members)), sharding, start_step
+    )
+
+
+__all__ = ["ElasticBatchStream", "reference_batches"]

@@ -1,0 +1,89 @@
+"""Elastic gang member agent: the seat-holder process for non-trainer hosts.
+
+In an elastic training gang the coordinator (member 0) owns the training
+loop; every other member's *user process* is this agent. It holds the
+member's seat in the gang — the executor wrapping it registers with the
+AM and heartbeats, which is the liveness signal the membership protocol
+rides — watches the generation broadcast so membership changes land in
+its log (and on the merged trace), and exits promptly when fenced.
+
+On a real TPU fleet the agent's host contributes its chips to the shared
+mesh; chaos ``kill_container`` aimed at this process IS the preemption
+under test: the executor's process group dies, the AM detects the loss,
+declares a shrink generation, and the trainer reshards — no agent logic
+is on that path, which is the point (a preempted host gets no chance to
+run cleanup).
+
+Run as ``python -m tony_tpu.elastic.member`` (the job.<type>.command of
+elastic member task types).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import time
+
+from tony_tpu.elastic.protocol import ENV_MEMBER, read_generation
+from tony_tpu.obs import trace
+
+log = logging.getLogger(__name__)
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s MEMBER %(levelname)s %(name)s: %(message)s",
+    )
+    trace.install_from_env()  # join the job's trace spine (no-op untraced)
+    app_dir = os.environ.get("TONY_APP_DIR", "")
+    member = int(os.environ.get(ENV_MEMBER, os.environ.get("TONY_PROCESS_ID", "0")))
+    stop = {"fenced": False}
+
+    def _term(*_):
+        stop["fenced"] = True
+
+    signal.signal(signal.SIGTERM, _term)
+    log.info("elastic member %d holding its seat (app_dir=%s)", member, app_dir)
+    # membership self-fence patience: a RELAUNCHED agent necessarily boots
+    # while the broadcast still shows the shrink generation that removed
+    # its seat — the AM declares the grow only after this agent's executor
+    # registers. Exclusion is therefore only a fence once it PERSISTS; a
+    # genuinely fenced ghost also gets ABORT on its (stale-attempt)
+    # heartbeat long before this timer, so the file path is pure backstop.
+    fence_after_s = 10.0
+    excluded_since: float | None = None
+    with trace.span("elastic.member", member=member):
+        last_gen = -1
+        while not stop["fenced"]:
+            rec = read_generation(app_dir) if app_dir else None
+            if rec is not None and rec.generation != last_gen:
+                last_gen = rec.generation
+                log.info(
+                    "generation %d (%s): members=%s",
+                    rec.generation, rec.boundary, list(rec.members),
+                )
+                trace.instant(
+                    "elastic.member_generation", member=member,
+                    generation=rec.generation, boundary=rec.boundary,
+                )
+            if rec is not None and member not in rec.members:
+                if excluded_since is None:
+                    excluded_since = time.monotonic()
+                elif time.monotonic() - excluded_since > fence_after_s:
+                    log.warning(
+                        "member %d fenced out of generation %d for %.0fs; "
+                        "exiting", member, rec.generation, fence_after_s,
+                    )
+                    break
+            else:
+                excluded_since = None
+            time.sleep(0.2)
+    trace.uninstall()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,167 @@
+"""Checkpoint shadowing: a host-RAM replica of the sharded train state.
+
+The elastic recovery point must not live on disk: a preempted host costs
+seconds, a cold orbax restore costs minutes. The :class:`ShadowStore`
+keeps a recent full copy of the TrainState in host RAM:
+
+- **async stride shadows** — every ``interval_steps`` the fit loop hands
+  the store a reference to the live (device) state; a single bounded
+  daemon thread performs the device->host transfer off the step loop
+  (exactly the health sentinel's queue discipline: the D2H sync lands on
+  the worker thread, never on the dispatch path). If a transfer is still
+  in flight the new request is dropped, not queued — the shadow is a
+  bounded-lag recovery point, not a log.
+- **fence shadows** — at a generation boundary the loop calls
+  :meth:`capture_sync` AFTER draining the device: the result is the
+  *exact current* state, which is what makes elastic shrink lose zero
+  steps (survivors donate from this capture; the periodic shadow is the
+  fallback recovery point when a fence cannot complete, and its age
+  bounds the lost steps in that path).
+- **donation / restore** — :func:`reshard_state` device_puts a host
+  shadow onto any new mesh's shardings: the same call serves shrink
+  (survivors re-layout onto fewer members), grow-back (the relaunched
+  member syncs from survivors' RAM), and the rollback path
+  (:meth:`snapshot` + reshard = resume at the shadowed step).
+
+The store never touches disk and holds at most one full host replica plus
+one in-flight transfer.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+
+class ShadowStore:
+    """Bounded background device->host state replica (see module doc)."""
+
+    def __init__(self, interval_steps: int = 16):
+        self.interval_steps = max(int(interval_steps), 1)
+        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._lock = threading.Lock()  # guards (_step, _host) swaps only
+        self._step = -1
+        self._host: Any = None
+        self._dropped = 0
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name="tony-elastic-shadow"
+        )
+        self._thread.start()
+
+    # --- producer side (fit loop) --------------------------------------------
+
+    def maybe_update(self, step: int, state: Any) -> bool:
+        """Stride-gated async shadow request; returns whether one was
+        enqueued. Never blocks: a busy worker means the previous shadow is
+        still transferring and this stride is skipped (bounded lag =
+        at most 2x the interval).
+
+        The enqueued arrays are device-side COPIES, not the live state:
+        the train step donates its state argument, so the caller's
+        reference is deleted the moment the next step dispatches — a
+        worker device_get on it would race the donation and fail. The
+        copy dispatches asynchronously (no step-loop stall) at the cost
+        of one transient extra state replica on device per shadow; size
+        the stride accordingly on HBM-tight configs.
+        """
+        if step % self.interval_steps:
+            return False
+        if self._q.full():
+            self._dropped += 1
+            return False
+        import jax
+
+        try:
+            copy = jax.tree.map(lambda x: x.copy(), state)
+            self._q.put_nowait((step, copy))
+            return True
+        except queue.Full:
+            self._dropped += 1
+            return False
+
+    def capture_sync(self, step: int, state: Any) -> Any:
+        """Synchronous full device->host capture (the fence-boundary path);
+        also installs the result as the current shadow and returns it."""
+        import jax
+
+        host = jax.device_get(state)
+        with self._lock:
+            self._step, self._host = step, host
+        return host
+
+    # --- worker ---------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            step, state = item
+            try:
+                import jax
+
+                host = jax.device_get(state)
+            except Exception:
+                # a failed transfer is a MISSED shadow, not a logged
+                # curiosity: it must show in `dropped` or a permanently
+                # failing path would report a perfect record
+                self._dropped += 1
+                log.warning("shadow transfer failed at step %d", step,
+                            exc_info=True)
+                continue
+            with self._lock:
+                if step > self._step:
+                    self._step, self._host = step, host
+
+    # --- consumer side --------------------------------------------------------
+
+    def snapshot(self) -> tuple[int, Any] | None:
+        """(step, host state) of the most recent completed shadow, or None
+        when nothing has been shadowed yet."""
+        with self._lock:
+            if self._host is None:
+                return None
+            return self._step, self._host
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def drain(self, timeout_s: float = 10.0) -> None:
+        """Settle any in-flight transfer (tests / pre-fence)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            # worker is mid-transfer; it will pick the sentinel up next
+            try:
+                self._q.put(None, timeout=1.0)
+            except queue.Full:
+                pass
+        self._thread.join(timeout=5.0)
+
+
+def reshard_state(host_state: Any, shardings: Any) -> Any:
+    """Place a host shadow onto a (new) mesh's shardings leaf by leaf —
+    the donation path: shrink, grow-back sync, and shadow rollback all
+    reduce to this one device_put."""
+    import jax
+
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), host_state, shardings)
+
+
+__all__ = ["ShadowStore", "reshard_state"]

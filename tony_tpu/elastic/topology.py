@@ -1,0 +1,114 @@
+"""ElasticTopology: member-granular, runtime-swappable device meshes.
+
+The repo's meshes were fixed at ``fit()`` entry; elastic training needs the
+mesh to be a *function of the current membership*. The unit of elasticity
+is a **member** — one gang seat owning an equal slice of the device set
+(on real TPU fleets, one host's chips; under the CPU test platform, a
+contiguous group of local devices). Members map onto the ``dp`` axis
+outermost: parameters and optimizer state are replicated across members
+(sharded only over the per-member axes inside a member's devices), which
+is exactly what makes survivors *whole* — when a member dies, the
+remaining members already hold the complete current state and resharding
+is a relayout, not a recovery.
+
+``mesh_for(members)`` builds the mesh for any live subset: ``dp`` shrinks
+to the member count, the per-member shape (fsdp/tp/sp within a member's
+devices) is preserved, and member device groups stay in member order so
+the dp coordinate *is* the member's rank among survivors. The train step
+is re-lowered against the result through the existing compile-ahead path
+(train/loop.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+from jax.sharding import Mesh
+
+from tony_tpu.parallel.mesh import MESH_AXES, MeshShape
+
+
+@dataclass
+class ElasticTopology:
+    """Partition of a device set into ``n_members`` equal groups.
+
+    ``per_member`` is the mesh shape INSIDE one member's device group; its
+    ``dp`` must be 1 (the dp axis is the member axis — a per-member dp
+    would make the member boundary invisible to the reshard path).
+    """
+
+    n_members: int
+    per_member: MeshShape | None = None
+    devices: list = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if self.n_members < 2:
+            raise ValueError(
+                f"elastic topology needs >= 2 members, got {self.n_members}"
+            )
+        if not self.devices:
+            import jax
+
+            self.devices = list(jax.devices())
+        if len(self.devices) % self.n_members:
+            raise ValueError(
+                f"{len(self.devices)} devices not divisible into "
+                f"{self.n_members} member groups"
+            )
+        per = len(self.devices) // self.n_members
+        if self.per_member is None:
+            # fsdp-first inside the member, mirroring default_shape(): the
+            # bandwidth-hungry axis stays on the member's own interconnect
+            self.per_member = MeshShape(fsdp=per)
+        if self.per_member.dp != 1:
+            raise ValueError(
+                "per_member.dp must be 1: the dp axis is the member axis "
+                f"(got per-member shape {self.per_member.sizes})"
+            )
+        if self.per_member.n_devices != per:
+            raise ValueError(
+                f"per-member shape {self.per_member.sizes} needs "
+                f"{self.per_member.n_devices} devices but each of the "
+                f"{self.n_members} members owns {per}"
+            )
+
+    @property
+    def devices_per_member(self) -> int:
+        return len(self.devices) // self.n_members
+
+    def member_devices(self, member: int) -> list:
+        per = self.devices_per_member
+        if not 0 <= member < self.n_members:
+            raise ValueError(f"member {member} outside 0..{self.n_members - 1}")
+        return self.devices[member * per : (member + 1) * per]
+
+    def shape_for(self, members: tuple[int, ...] | list[int]) -> MeshShape:
+        pm = self.per_member
+        return MeshShape(
+            dp=len(members), pp=pm.pp, fsdp=pm.fsdp, ep=pm.ep, tp=pm.tp,
+            sp=pm.sp,
+        )
+
+    def mesh_for(self, members: tuple[int, ...] | list[int]) -> Mesh:
+        """Mesh over the live members' devices, member-major on ``dp``.
+
+        Device order is deliberately member-major raveled (NOT
+        ``create_device_mesh``'s topology-optimised order): the dp
+        coordinate must identify the member so shrink/grow relayouts move
+        whole member groups — and dp is the latency-tolerant outer axis,
+        so member order costs nothing (the same reasoning that puts dp on
+        DCN in ``build_multislice_mesh``).
+        """
+        members = tuple(sorted(members))
+        if not members:
+            raise ValueError("elastic mesh needs at least one live member")
+        devs: list = []
+        for m in members:
+            devs.extend(self.member_devices(m))
+        shape = self.shape_for(members)
+        dev_array = np.asarray(devs, dtype=object).reshape(shape.sizes)
+        return Mesh(dev_array, MESH_AXES)
+
+
+__all__ = ["ElasticTopology"]

@@ -49,6 +49,16 @@ DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
      r"capacity_factor|top_k|slots_formula|kv_block|window)", "config", 0.0),
     # quality: loss/perplexity may not silently regress either
     (r"(loss|perplexity)", "lower", 0.02),
+    # elastic restart cost (tony_tpu/elastic/, bench `elastic` section):
+    # a lost step is a regression with ZERO tolerance (the whole point of
+    # elastic is losing none); warm-restart seconds and the post-shrink
+    # step-time ratio get timing slack. These must outrank the throughput
+    # rule below — `goodput.restart_s` would otherwise match its
+    # `goodput` pattern and be judged higher-better. Scenario shape
+    # (member count, boundary count) is configuration identity.
+    (r"(elastic.*(members|reshards)$|generation_changes)", "config", 0.0),
+    (r"(lost_steps)", "lower", 0.0),
+    (r"(restart_s|reshard_s|shrunk_step_ratio)", "lower", 0.25),
     # throughput-shaped (and headroom: MORE free HBM is better — this
     # must outrank the broad memory rule below or a headroom collapse
     # would be judged as a memory improvement): higher is better

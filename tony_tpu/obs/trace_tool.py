@@ -163,7 +163,12 @@ def goodput(app_dir: str,
     - ``input_blocked_s``: per-step input fetch time carried on sampled
       step spans, scaled by the stride;
     - ``restart_s``: gaps between one task's consecutive user-process
-      spans (the relaunch dead time a gang restart costs);
+      spans (the relaunch dead time a gang restart costs) PLUS
+      ``elastic.reshard`` span time (the warm-restart cost of an elastic
+      generation change — fence, donate, re-lower; docs/ELASTIC.md), so
+      restart cost is read straight off the merged trace instead of
+      inferred from ``unattributed_s``. ``generation_changes`` counts the
+      elastic boundaries separately from cold ``restarts``;
     - ``window_s``: first span start to last span end across processes;
     - ``unattributed_s``: the window time NO bucket claims, reported
       explicitly instead of silently folding into the denominator — the
@@ -180,7 +185,8 @@ def goodput(app_dir: str,
     out = {
         "window_s": 0.0, "productive_s": 0.0, "compile_s": 0.0,
         "restore_s": 0.0, "first_batch_s": 0.0, "input_blocked_s": 0.0,
-        "restart_s": 0.0, "restarts": 0, "sampled_steps": 0,
+        "restart_s": 0.0, "restarts": 0, "generation_changes": 0,
+        "sampled_steps": 0,
     }
     if not spans and not opens:
         return out
@@ -211,6 +217,11 @@ def goodput(app_dir: str,
             user_spans.setdefault(str(args.get("task", "?")), []).append(s)
         elif name == "am.gang_restart":
             out["restarts"] += 1
+        elif name == "elastic.reshard":
+            # warm restart: the generation boundary's fence+donate+relower
+            # window, journaled by the trainer (train/loop.py _Elastic)
+            out["restart_s"] += dur_s
+            out["generation_changes"] += 1
     # a SIGKILLed attempt's user_process span is begin-only (``ph: "B"``,
     # emergency-flushed): its ``fts`` flush timestamp is the kill-time
     # proxy, without which restart_s misses exactly the kill_container

@@ -2,6 +2,7 @@
 
 from tony_tpu.runtime.base import Runtime, TaskIdentity
 from tony_tpu.runtime.frameworks import (
+    ElasticRuntime,
     HorovodRuntime,
     MLGenericRuntime,
     MXNetRuntime,
@@ -15,7 +16,7 @@ _RUNTIMES = {
     cls.name: cls
     for cls in (
         JaxTpuRuntime, TFRuntime, PyTorchRuntime, HorovodRuntime,
-        MXNetRuntime, MLGenericRuntime, ServeRuntime,
+        MXNetRuntime, MLGenericRuntime, ServeRuntime, ElasticRuntime,
     )
 }
 
@@ -31,6 +32,7 @@ def make_runtime(framework: str) -> Runtime:
 
 
 __all__ = [
+    "ElasticRuntime",
     "HorovodRuntime",
     "JaxTpuRuntime",
     "MLGenericRuntime",

@@ -155,6 +155,91 @@ class MLGenericRuntime(Runtime):
         return True
 
 
+class ElasticRuntime(Runtime):
+    """Elastic training gangs (tony_tpu/elastic/; docs/ELASTIC.md).
+
+    The topology contract differs from the plain jax runtime: the gang is
+    NOT one jax.distributed world (a fixed world cannot lose a member
+    without wedging every survivor's collectives). Instead the
+    coordinator (rank 0, the trainer) is a single-controller jax process
+    over the live members' devices, and every other member's seat is held
+    by a member agent (``python -m tony_tpu.elastic.member``) whose
+    executor heartbeat is the liveness signal the membership protocol
+    rides. So each member runs its OWN single-process jax world
+    (TONY_NUM_PROCESSES = 1), and the member axis is exported through the
+    TONY_ELASTIC* contract the trainer's fit() arms on.
+    """
+
+    name = "elastic"
+
+    def validate(self, config: TonyConfig) -> None:
+        from tony_tpu.config.keys import Keys
+
+        specs = {
+            name: config.task_spec(name) for name in config.job_types()
+        }
+        # the coordinator must be the chief: job completion follows the
+        # trainer (member agents hold seats and never exit on their own),
+        # and the rank table puts "chief" first so it is member 0
+        if "chief" not in specs or specs["chief"].instances != 1:
+            raise ValueError(
+                "elastic jobs need a [job.chief] trainer with instances = 1 "
+                "(member agents run python -m tony_tpu.elastic.member)"
+            )
+        tracked_types = sorted(
+            name for name, s in specs.items() if not s.untracked
+        )
+        if tracked_types and tracked_types[0] != "chief":
+            # member ranks come from the sorted-type rank table; the AM's
+            # elastic path treats rank 0 as the trainer, so a member type
+            # sorting before "chief" would silently swap those roles
+            raise ValueError(
+                f"elastic member type {tracked_types[0]!r} sorts before "
+                "'chief': the trainer must be member 0 (rank table is "
+                "sorted-type order) — rename the member type"
+            )
+        tracked = sum(
+            s.instances for s in specs.values() if not s.untracked
+        )
+        min_members = config.get_int(Keys.ELASTIC_MIN_MEMBERS, 1)
+        if tracked < 2:
+            raise ValueError(
+                "elastic jobs need >= 2 tracked member instances "
+                f"(got {tracked}); a 1-member gang has nothing to shrink"
+            )
+        if not 1 <= min_members < tracked:
+            raise ValueError(
+                f"elastic.min_members={min_members} must be in "
+                f"[1, {tracked - 1}] for a {tracked}-member gang"
+            )
+
+    def build_env(self, identity: TaskIdentity, config: TonyConfig) -> dict[str, str]:
+        from tony_tpu.config.keys import Keys
+        from tony_tpu.elastic.protocol import (
+            ENV_ENABLED, ENV_MEMBER, ENV_MEMBERS, ENV_POLL, ENV_SHADOW,
+        )
+
+        env = super().build_env(identity, config)
+        # each member is its own single-process jax world: the trainer
+        # owns the live mesh, member agents own no devices at all
+        env.update(
+            {
+                "TONY_NUM_PROCESSES": "1",
+                "JAX_COORDINATOR_ADDRESS": "",
+                "JAX_NUM_PROCESSES": "1",
+                "JAX_PROCESS_ID": "0",
+                ENV_ENABLED: "1",
+                ENV_MEMBERS: str(identity.num_processes),
+                ENV_MEMBER: str(max(identity.process_id, 0)),
+                ENV_POLL: str(config.get_float(Keys.ELASTIC_POLL_S, 0.5)),
+                ENV_SHADOW: str(
+                    config.get_int(Keys.ELASTIC_SHADOW_STEPS, 16)
+                ),
+            }
+        )
+        return env
+
+
 class ServeRuntime(Runtime):
     """`tony serve` gang workers (serve/gang.py; docs/SERVE.md).
 

@@ -14,6 +14,8 @@ blocks on HBM->disk).
 from __future__ import annotations
 
 import logging
+import os
+import shutil
 from typing import Any
 
 import jax
@@ -21,13 +23,31 @@ import orbax.checkpoint as ocp
 
 log = logging.getLogger(__name__)
 
+# orbax's in-progress marker: saves land in `<step>.orbax-checkpoint-tmp-*`
+# and are atomically renamed on commit, so a SIGKILL mid-save (the exact
+# elastic-preemption scenario) leaves a tmp dir, never a torn final step
+_TMP_MARKER = ".orbax-checkpoint-tmp"
+
 
 class CheckpointManager:
-    """Thin orbax wrapper bound to a directory and keep policy."""
+    """Thin orbax wrapper bound to a directory and keep policy.
+
+    Crash-safety contract (pinned by tests/test_elastic.py's
+    kill-mid-save test): a process SIGKILLed at ANY point during save can
+    never corrupt the latest checkpoint — in-progress saves live in a
+    temp dir and only an atomic rename publishes them. This wrapper adds
+    the two pieces orbax leaves to the caller: stale tmp dirs from a
+    killed predecessor are reaped at open (they would otherwise
+    accumulate forever under the job dir), and ``restore`` falls back to
+    the previous durable step if the newest one turns out unreadable
+    (e.g. a non-atomic-rename filesystem) instead of wedging the restart
+    on the exact artifact the crash produced.
+    """
 
     def __init__(self, directory: str, *, keep: int = 3, save_interval_steps: int = 0):
         self.directory = directory
         self._interval = save_interval_steps
+        self._reap_interrupted_saves()
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -36,6 +56,24 @@ class CheckpointManager:
                 enable_async_checkpointing=True,
             ),
         )
+
+    def _reap_interrupted_saves(self) -> None:
+        """Drop tmp dirs a SIGKILLed save left behind. Only ever touches
+        ``*.orbax-checkpoint-tmp-*`` names — committed steps are plain
+        ``<step>/`` dirs and can never match."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if _TMP_MARKER not in name:
+                continue
+            path = os.path.join(self.directory, name)
+            log.warning(
+                "reaping interrupted checkpoint save %s (crashed mid-save)",
+                path,
+            )
+            shutil.rmtree(path, ignore_errors=True)
 
     def should_save(self, step: int) -> bool:
         return self._interval > 0 and step % self._interval == 0
@@ -50,16 +88,39 @@ class CheckpointManager:
         """Restore the latest (or given) step into the template's shardings.
 
         Returns (state, step); (template, -1) when no checkpoint exists —
-        the caller starts from scratch.
+        the caller starts from scratch. When restoring the LATEST step, an
+        unreadable newest checkpoint (a crash mid-save on a filesystem
+        without atomic rename) falls back to the PREVIOUS durable step —
+        the elastic restart must come back from something, not wedge on
+        the one artifact the crash produced. The fallback is exactly one
+        step deep: only the newest step can be crash-torn, so a second
+        consecutive failure is a systematic problem (changed model shape,
+        corrupt store) and re-raises rather than silently walking every
+        checkpoint back to from-scratch training. An explicitly-requested
+        step always raises: the caller asked for that exact state.
         """
         target = step if step is not None else self.latest_step()
         if target is None or target < 0:
             return state_template, -1
-        restored = self._mgr.restore(
-            target,
-            args=ocp.args.StandardRestore(jax.tree.map(_as_restore_leaf, state_template)),
-        )
-        return restored, target
+        template = jax.tree.map(_as_restore_leaf, state_template)
+        try:
+            return self._mgr.restore(
+                target, args=ocp.args.StandardRestore(template)
+            ), target
+        except Exception:
+            if step is not None:
+                raise
+            earlier = [s for s in (self._mgr.all_steps() or []) if s < target]
+            if not earlier:
+                raise
+            prev = max(earlier)
+            log.warning(
+                "checkpoint step %d unreadable (interrupted save?); "
+                "falling back to step %d", target, prev, exc_info=True,
+            )
+            return self._mgr.restore(
+                prev, args=ocp.args.StandardRestore(template)
+            ), prev
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

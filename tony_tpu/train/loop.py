@@ -97,11 +97,28 @@ class FitConfig:
     moe_dispatch: str = ""
     # grouped-GEMM row tile override (0 keeps model.moe_group_block)
     moe_group_block: int = 0
+    # elastic training (tony_tpu/elastic/, docs/ELASTIC.md): gang size at
+    # full strength. 0 disables; >= 2 makes the mesh runtime-swappable —
+    # the dp axis maps to members and shrinks/grows at AM-declared
+    # generation boundaries while training continues from the in-memory
+    # state of survivors. mesh_shape then means the PER-MEMBER shape
+    # (dp must stay 1) and data.global_batch the full-membership batch.
+    elastic_members: int = 0
+    # scripted membership plan {step: (member, ...)} applied at step
+    # boundaries — the in-process twin of the AM's generation broadcast
+    # (bench `elastic` section + tests drive shrink/grow through it)
+    elastic_plan: dict | None = None
+    # broadcast + journal root; empty -> TONY_APP_DIR (the shared app dir
+    # the AM writes generation.json into)
+    elastic_dir: str = ""
+    # checkpoint-shadow stride in steps (0 -> env/default 16)
+    elastic_shadow_steps: int = 0
 
     def apply_job_env(self) -> None:
         """Fill unset checkpoint fields from the TONY_CHECKPOINT_* env the
         executor exported (the checkpoint.dir / checkpoint.interval_steps /
-        restart.resume_from_checkpoint job-config glue)."""
+        restart.resume_from_checkpoint job-config glue), and arm elastic
+        membership from the TONY_ELASTIC* env the ElasticRuntime exports."""
         if not self.checkpoint_dir and os.environ.get("TONY_CHECKPOINT_DIR"):
             self.checkpoint_dir = os.environ["TONY_CHECKPOINT_DIR"]
             if self.checkpoint_every == 0:
@@ -112,6 +129,10 @@ class FitConfig:
                 os.environ.get("TONY_CHECKPOINT_KEEP", str(self.checkpoint_keep))
             )
             self.resume = os.environ.get("TONY_RESUME_FROM_CHECKPOINT", "true") == "true"
+        if self.elastic_members == 0 and os.environ.get("TONY_ELASTIC") == "1":
+            self.elastic_members = int(
+                os.environ.get("TONY_ELASTIC_MEMBERS", "0") or 0
+            )
 
 
 def fit(cfg: FitConfig) -> dict:
@@ -160,6 +181,196 @@ def _start_async_host_copy(metrics: dict) -> None:
                 pass
 
 
+class _Elastic:
+    """fit()'s elastic runtime: the swappable topology + its bookkeeping.
+
+    Owns the member-granular :class:`~tony_tpu.elastic.ElasticTopology`,
+    the generation watcher, the host-RAM checkpoint shadow, and the
+    membership-aware batch stream; :meth:`reshard` is the generation
+    boundary — fence, donate, rebuild, continue (docs/ELASTIC.md).
+    """
+
+    def __init__(self, cfg: FitConfig):
+        from tony_tpu import elastic
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "elastic fit() is single-controller: the trainer process "
+                "owns every live member's devices (jax.process_count() "
+                "must be 1; member seats are separate non-jax agents)"
+            )
+        self._elastic = elastic
+        self.cfg = cfg
+        # ONE parser for the TONY_ELASTIC* contract (ElasticSettings
+        # .from_env); FitConfig fields override what they own
+        settings = (
+            elastic.ElasticSettings.from_env() or elastic.ElasticSettings()
+        )
+        settings.members = cfg.elastic_members or settings.members
+        if cfg.elastic_dir:
+            settings.app_dir = cfg.elastic_dir
+        elif not settings.app_dir:
+            # FitConfig-armed elastic inside a tony job still journals to
+            # the shared app dir
+            settings.app_dir = os.environ.get("TONY_APP_DIR", "")
+        if cfg.elastic_shadow_steps:
+            settings.shadow_interval_steps = cfg.elastic_shadow_steps
+        self.controller = elastic.ElasticController(
+            settings, watch=bool(settings.app_dir)
+        )
+        self.topology = elastic.ElasticTopology(
+            cfg.elastic_members, per_member=cfg.mesh_shape
+        )
+        self.shadow = elastic.ShadowStore(
+            interval_steps=settings.shadow_interval_steps
+        )
+        self.mesh = self.topology.mesh_for(self.controller.members)
+        self.stream = None   # built once fit knows the batch sharding
+        self.plan = dict(cfg.elastic_plan or {})
+        self.reshards = 0
+        self.reshard_s = 0.0
+
+    @property
+    def journal(self):
+        return self.controller.journal
+
+    def make_stream(self, batch_sharding, start_step: int):
+        self.stream = self._elastic.ElasticBatchStream(
+            self.cfg.data, self.cfg.elastic_members, self.controller.members,
+            batch_sharding, start_step=start_step,
+        )
+        return self.stream
+
+    def pending(self, step: int):
+        """The membership change to apply at this boundary, if any: the
+        scripted plan (bench/tests) outranks the file broadcast so a plan
+        stays deterministic even inside a traced job. A record whose
+        membership already matches (e.g. a member died and grew back
+        between two boundaries — net no-op) is adopted here, where
+        membership is settled, without a reshard."""
+        members = self.plan.pop(step, None)
+        if members is not None and set(members) != set(self.controller.members):
+            old = set(self.controller.members)
+            new = set(int(m) for m in members)
+            return self._elastic.GenerationRecord(
+                generation=self.controller.generation + 1,
+                members=tuple(sorted(new)),
+                boundary="shrink" if old - new else "grow",
+                dead=tuple(sorted(old - new)),
+                added=tuple(sorted(new - old)),
+                reason="scripted plan",
+            )
+        rec = self.controller.pending()
+        if rec is not None and set(rec.members) == set(self.controller.members):
+            self.controller.applied(rec)
+            return None
+        return rec
+
+    def note_step(self, step: int) -> None:
+        if self.journal is not None:
+            self.journal.step(
+                step, self.controller.generation, self.controller.members
+            )
+
+    def reshard(self, rec, step: int, state, optimizer, rules, ledger):
+        """One generation boundary: returns the rebuilt
+        ``(state, step_fn, compiled_step, mesh, batch_sharding)``.
+
+        The span is the restart-cost evidence: ``tony trace`` goodput's
+        ``restart_s`` bucket sums ``elastic.reshard`` spans (the warm
+        path) next to relaunch gaps (the cold one).
+        """
+        from tony_tpu.parallel.mesh import set_default_mesh
+        from tony_tpu.parallel.sharding import spec_for
+        from tony_tpu.train.trainer import (
+            make_train_step, state_shardings, train_state_avals,
+        )
+
+        cfg = self.cfg
+        members = tuple(sorted(rec.members))
+        t0 = time.perf_counter()
+        members_str = ",".join(str(m) for m in members)
+        dead_str = ",".join(str(m) for m in rec.dead)
+        with trace.span(
+            "elastic.reshard", generation=rec.generation,
+            boundary=rec.boundary, at_step=step,
+            members=members_str, dead=dead_str,
+        ):
+            # fence: drain the dispatch backlog, then take the exact
+            # current state device->host — the donation every survivor
+            # (and a grown-back member) reshards from. Zero steps lost:
+            # the recovery point IS the fenced state, the periodic shadow
+            # is only the fallback when a fence cannot complete.
+            jax.block_until_ready(state)
+            host_state = self.shadow.capture_sync(step, state)
+            self.mesh = self.topology.mesh_for(members)
+            set_default_mesh(self.mesh)
+            shardings = state_shardings(cfg.model, self.mesh, optimizer, rules)
+            state = self._elastic.reshard_state(host_state, shardings)
+            step_fn = make_train_step(
+                cfg.model, self.mesh, optimizer, rules,
+                n_microbatches=cfg.pp_microbatches,
+                pp_schedule=cfg.pp_schedule,
+            )
+            batch_sharding = NamedSharding(
+                self.mesh, spec_for(("batch", "seq"), cfg.rules)
+            )
+            skipped = self.stream.reshard(members, batch_sharding)
+            compiled = None
+            if cfg.compile_ahead:
+                # re-lower against the shrunk/grown topology through the
+                # same AOT path startup uses (persistent XLA cache makes a
+                # grow back to a previously-seen shape a cache hit)
+                batch_aval = jax.ShapeDtypeStruct(
+                    (self.stream.global_batch, cfg.data.seq_len), jnp.int32
+                )
+                try:
+                    with ledger.label("train.step"):
+                        compiled = step_fn.lower(
+                            train_state_avals(cfg.model, optimizer),
+                            batch_aval, batch_aval,
+                        ).compile()
+                except Exception:
+                    log.warning(
+                        "elastic re-lower failed; jit dispatch compiles "
+                        "lazily", exc_info=True,
+                    )
+        dt = time.perf_counter() - t0
+        self.reshards += 1
+        self.reshard_s += dt
+        if self.journal is not None:
+            self.journal.reshard(
+                generation=rec.generation, at_step=step,
+                boundary=rec.boundary, members=members, dead=rec.dead,
+                added=rec.added, skipped=skipped, reshard_s=dt,
+                lost_steps=0,
+            )
+        self.controller.applied(rec)
+        if jax.process_index() == 0:
+            log.warning(
+                "elastic generation %d (%s) applied at step %d in %.2fs: "
+                "members=%s global_batch=%d",
+                rec.generation, rec.boundary, step, dt, list(members),
+                self.stream.global_batch,
+            )
+        return state, step_fn, compiled, self.mesh, batch_sharding
+
+    def summary(self) -> dict:
+        return {
+            "generation": self.controller.generation,
+            "members": list(self.controller.members),
+            "reshards": self.reshards,
+            "reshard_s": round(self.reshard_s, 3),
+            "shadow_dropped": self.shadow.dropped,
+        }
+
+    def close(self) -> None:
+        self.shadow.close()
+        if self.stream is not None:
+            self.stream.close()
+        self.controller.close()
+
+
 def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     jax_tpu.initialize()  # no-op outside a tony-tpu job
     # always-on compile journal (obs/compiles.py): every XLA backend
@@ -186,6 +397,15 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             overrides["moe_group_block"] = cfg.moe_group_block
         cfg.model = _replace(cfg.model, **overrides)
     cache_dir = os.environ.get("TONY_JAX_CACHE_DIR", "")
+    if cache_dir and cfg.elastic_members >= 2:
+        # elastic runs re-lower the step per generation; round-tripping
+        # those executables through the persistent cache corrupts the
+        # process on this jax line (a deserialized executable for a
+        # previously-seen topology aborts a few steps after a grow
+        # boundary). The cache's win is submit->first-step; the elastic
+        # warm path keeps survivors' executables in memory anyway.
+        log.info("elastic fit: persistent XLA cache disabled")
+        cache_dir = ""
     if cache_dir:
         # persistent XLA compilation cache (train.jax_cache, default on):
         # a resubmitted or gang-restarted job loads its executables instead
@@ -211,7 +431,14 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
         reporter = MetricsReporter()
         if reporter.active:
             on_metrics = reporter.push
-    mesh = build_mesh(cfg.mesh_shape)
+    el = None
+    if cfg.elastic_members >= 2:
+        # elastic job: the mesh is a function of the current membership
+        # (dp = live members), swapped at generation boundaries below
+        el = _Elastic(cfg)
+        mesh = el.mesh
+    else:
+        mesh = build_mesh(cfg.mesh_shape)
     # model-level attention hooks ('ring'/'flash') resolve this mesh
     from tony_tpu.parallel.mesh import set_default_mesh
 
@@ -297,7 +524,10 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     batch_sharding = NamedSharding(mesh, spec_for(("batch", "seq"), cfg.rules))
     # the prefetch producer (data.prefetch > 0) starts generating + placing
     # batches here, concurrent with the compile-ahead join below
-    batches = make_batches(cfg.data, batch_sharding, start_step=start_step)
+    if el is not None:
+        batches = el.make_stream(batch_sharding, start_step)
+    else:
+        batches = make_batches(cfg.data, batch_sharding, start_step=start_step)
     if compile_thread is not None:
         compile_thread.join()
     compiled_step = aot.get("step")
@@ -315,10 +545,14 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
         # device-to-host transfers disallowed — the log boundary is the
         # one place a sync is intended, so it is spelled out
         loss = float(jax.device_get(m["loss"]))
+        # scale from the snapshot, not the live loop: the deferred emit
+        # may resolve after an elastic reshard rebound tokens_per_step
+        # and the mesh, and the straddling window must report at the
+        # scale it actually ran at
         timer = StepTimer(
             flops_per_token=flops_per_token,
-            tokens_per_step=tokens_per_step,
-            n_chips=mesh.size,
+            tokens_per_step=snap.get("tokens_per_step", tokens_per_step),
+            n_chips=snap.get("n_chips", mesh.size),
         )
         timer.record(snap["dt"], snap["window"], host_blocked_s=snap["host_s"])
         out = {
@@ -340,6 +574,16 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
         from tony_tpu.obs.tpu_metrics import tpu_metrics_dict
 
         out.update(tpu_metrics_dict())
+        if el is not None and el.journal is not None:
+            # loss-continuity evidence: the log boundary's already-synced
+            # scalars ride into the elastic journal (0-based step index,
+            # generation captured at snapshot time — a deferred emit must
+            # not stamp a boundary it predates)
+            fp = m.get("health/batch_fingerprint")
+            el.journal.loss(
+                snap["step"] - 1, snap.get("gen", 0), loss,
+                int(jax.device_get(fp)) if fp is not None else None,
+            )
         if jax.process_index() == 0:
             log.info(
                 "step %(step)d loss=%(loss)s %(tokens_per_sec_per_chip)s tok/s/chip "
@@ -426,6 +670,31 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     watchdog = None
     try:
         for step in range(start_step, cfg.steps):
+            if el is not None:
+                # elastic generation boundary: a pending membership change
+                # (AM broadcast or scripted plan) is applied HERE — fence,
+                # donate from the fenced state, rebuild mesh/step/stream
+                # against the new topology, keep stepping
+                rec = el.pending(step)
+                if rec is not None:
+                    if watchdog is not None:
+                        # a reshard legitimately re-compiles: step out of
+                        # the sanitizer for the boundary and re-arm after,
+                        # so the compile watchdog budgets steady state only
+                        san_stack.close()
+                        watchdog = None
+                    (state, step_fn, compiled_step, mesh,
+                     batch_sharding) = el.reshard(
+                        rec, step, state, optimizer, rules, ledger
+                    )
+                    batches = el.stream
+                    tokens_per_step = (
+                        el.stream.global_batch * cfg.data.seq_len
+                    )
+                    if sanitize.enabled() and steady_t0 is not None:
+                        watchdog = san_stack.enter_context(
+                            sanitize.sanitized_loop("fit")
+                        )
             t_fetch = time.perf_counter()
             if step == start_step:
                 with trace.span("fit.startup.first_batch"):
@@ -483,6 +752,11 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             # stride-counted series scrape: host-side locals + counters
             # only; journaling happens on the recorder's writer thread
             series.sample()
+            if el is not None:
+                # membership evidence (host-side append, no sync) + the
+                # async device->host checkpoint shadow on its stride
+                el.note_step(step)
+                el.shadow.maybe_update(step + 1, state)
             window += 1
             if pending is not None:
                 _emit(pending)  # previous boundary, now that N+1 is in flight
@@ -500,6 +774,9 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
                     "window": window,
                     "host_s": host_window_s,
                     "startup": dict(startup) if step == start_step else None,
+                    "gen": el.controller.generation if el is not None else 0,
+                    "tokens_per_step": tokens_per_step,
+                    "n_chips": mesh.size,
                 }
                 _start_async_host_copy(metrics)
                 if tracer is None and step != start_step:
@@ -539,6 +816,10 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
         # here — the partial trace + manifest land instead of vanishing
         profile.finish_capture()
         close_batches(batches)
+        if el is not None:
+            # shadow thread + generation watcher + journal handle; the
+            # stream was closed above (close_batches), close() tolerates it
+            el.close()
         if recorder is not None:
             # final scrape (the shutdown state lands in the journal, and
             # any last-window SLO trip evaluates) before the source whose
@@ -618,6 +899,11 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
         final["host_blocked_frac"] = round(host_steady_s / steady_elapsed, 4)
     if startup:
         final["startup"] = dict(startup)
+    if el is not None:
+        # elastic roll-up: final generation/membership, warm-restart count
+        # + cost (the same number `tony trace` goodput reads off the
+        # elastic.reshard spans as restart_s)
+        final["elastic"] = el.summary()
     if jax.process_index() == 0:
         # shutdown summary: silent metric loss must be visible in the
         # worker log, not only behind the portal
