@@ -539,6 +539,82 @@ def decode_bench(on_tpu: bool) -> dict:
         i += 1
     out["mixed_arrivals"] = engM.metrics.summary()
 
+    # (d) 90%-shared-prefix trace, store on vs off (serve/prefix.py): the
+    # cross-request-reuse headline. Requests share a long template prefix
+    # and differ only in a short tail; with the store on, admission
+    # matches the prefix and prefills only the tail — TTFT and prefill
+    # FLOPs (from the compile ledger's AOT cost_analysis) collapse to the
+    # tail's. Sequential single-request runs so TTFT is unblurred.
+    from tony_tpu.obs.compiles import get_ledger
+
+    # trace lengths chosen so the tail bucket is genuinely smaller than
+    # the full-prompt bucket (at the tiny CPU shapes the default request
+    # lengths would pad tail and prompt into the same bucket)
+    prefix_total = 512 if on_tpu else 56
+    shared_len = int(round(0.9 * prefix_total))
+    tail_len = prefix_total - shared_len
+    shared_prefix = rng.integers(0, cfg.vocab_size, shared_len)
+
+    def prefix_mode(on: bool) -> dict:
+        eng = Engine(params, cfg, ServeConfig(
+            slots=2, max_len=max_len, kv_block=block, prefix=on,
+        ))
+        def reqs(seed):
+            r2 = np.random.default_rng(seed)
+            return [
+                Request(
+                    prompt=np.concatenate(
+                        [shared_prefix,
+                         r2.integers(0, cfg.vocab_size, tail_len)]
+                    ),
+                    max_new_tokens=max_new, rng=seed * 1000 + i,
+                )
+                for i in range(n_req)
+            ]
+        for r in reqs(7):   # warm: compiles paid, prefix registered
+            eng.run([r])
+        eng.reset_metrics()
+        ttfts = []
+        for r in reqs(8):
+            done = eng.run([r])
+            ttfts.extend(c.ttft_s for c in done.values())
+        ttfts.sort()
+        m = eng.metrics.summary()
+        return {
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 5),
+            "ttft_p99_s": round(ttfts[-1], 5),
+            "prefix_hit_rate": m.get("prefix_hit_rate", 0.0),
+        }
+
+    ledger = get_ledger()
+    p_on, p_off = prefix_mode(True), prefix_mode(False)
+    # prefill FLOPs per request from the ledger's AOT entries: the full
+    # bucket the off-mode pays vs the tail bucket the store leaves
+    flops_by_name = {
+        e["fn"]: e.get("flops", 0.0) for e in ledger.entries("aot")
+    }
+    full_flops = max(
+        (v for k, v in flops_by_name.items()
+         if k.startswith("serve.prefill[")), default=0.0,
+    )
+    tail_flops = max(
+        (v for k, v in flops_by_name.items()
+         if k.startswith("serve.prefill_tail[")), default=0.0,
+    )
+    trace_out = {
+        "shared_len": shared_len, "tail_len": tail_len,
+        "prefix_on": p_on, "prefix_off": p_off,
+    }
+    if p_off["ttft_p50_s"] > 0:
+        trace_out["ttft_p50_ratio"] = round(
+            p_on["ttft_p50_s"] / p_off["ttft_p50_s"], 3
+        )
+    if full_flops > 0 and tail_flops > 0:
+        trace_out["prefill_flops_full"] = full_flops
+        trace_out["prefill_flops_tail"] = tail_flops
+        trace_out["prefill_flops_ratio"] = round(tail_flops / full_flops, 4)
+    out["prefix_trace"] = trace_out
+
     # native-GQA decode kernel vs the repeat-expanded reference (one
     # decode step of attention at full cache length, layer-scanned so
     # dispatch overhead amortises)
@@ -619,7 +695,13 @@ def gqa_capacity_demo() -> dict:
         "max_slots_repeat_formula": max(0, budget_formula // per_slot_repeat),
     }
     try:
-        measured = derive_slot_budget(cfg, max_len=max_len, hbm_bytes=hbm)
+        # shared_prefix_tokens: the prefix-store accounting — slot budget
+        # when every request carries a half-max_len shared template prefix
+        # (one refcounted physical copy; each slot pays only its tail)
+        measured = derive_slot_budget(
+            cfg, max_len=max_len, hbm_bytes=hbm,
+            shared_prefix_tokens=max_len // 2,
+        )
         out.update(measured)
         out["param_gb"] = round(measured["param_bytes"] / 2**30, 2)
         if measured["max_slots_native"]:

@@ -312,8 +312,11 @@ class TestCapacityDerivation:
         cfg = LlamaConfig.tiny()
         small = decode_step_analysis(cfg, slots=2, capacity=16, kv_block=16)
         big = decode_step_analysis(cfg, slots=2, capacity=64, kv_block=16)
+        # paged layout: the argument side is the physical-block pool plus
+        # the per-slot block table — growth is exactly their sum
         assert big["argument_bytes"] - small["argument_bytes"] == (
-            big["cache_bytes"] - small["cache_bytes"]
+            (big["cache_bytes"] - small["cache_bytes"])
+            + (big["table_bytes"] - small["table_bytes"])
         )
 
 

@@ -341,7 +341,7 @@ class TestServeRules:
         params = init_params(jax.random.key(0), cfg)
         eng = Engine(params, cfg, ServeConfig(slots=2, max_len=32, kv_block=8))
         assert eng._monitors is False
-        out = eng._decode_impl(params, eng.cache, eng.state)
+        out = eng._decode_impl(params, eng.cache, eng._table_dev, eng.state)
         assert out[3] == {}
 
 

@@ -55,6 +55,23 @@ class TestFlattenAndRules:
         assert rule_for(
             "extra.step_anatomy.top_collective.bytes"
         )[0] == "config"
+        # prefix store (serve/prefix.py): hit rate is higher-better; the
+        # on/off TTFT and prefill-FLOPs ratios are lower-better (a ratio
+        # drifting toward 1.0 means the reuse stopped paying); residency
+        # is trace-shaped, never judged
+        assert rule_for(
+            "extra.decode.prefix_trace.prefix_on.prefix_hit_rate"
+        )[0] == "higher"
+        assert rule_for(
+            "extra.decode.prefix_trace.ttft_p50_ratio"
+        )[0] == "lower"
+        assert rule_for(
+            "extra.decode.prefix_trace.prefill_flops_ratio"
+        )[0] == "lower"
+        assert rule_for(
+            "extra.decode.prefix_trace.prefix_on.ttft_p99_s"
+        )[0] == "lower"
+        assert rule_for("decode_0.prefix_resident_mb")[0] == "skip"
 
     def test_headroom_collapse_is_a_regression(self):
         v = diff(
@@ -90,11 +107,20 @@ class TestVerdict:
         assert "extra.elastic.restart_s" in keys
         assert "extra.elastic.goodput.restart_s" in keys
         assert "extra.elastic.shrunk_step_ratio" in keys
+        # the prefix-store section gates too: a hit-rate collapse, the
+        # on/off TTFT ratio drifting past 1.0, and tail FLOPs growing back
+        # toward the full-prompt cost all flag
+        assert "extra.decode.prefix_trace.prefix_on.prefix_hit_rate" in keys
+        assert "extra.decode.prefix_trace.ttft_p50_ratio" in keys
+        assert "extra.decode.prefix_trace.prefill_flops_ratio" in keys
         # within-tolerance drift is NOT flagged
         assert "extra.loss" not in keys          # +0.04% << 2%
         assert "extra.peak_hbm_gb" not in keys   # +1.5% << 10%
-        # worst regression leads the report
-        assert v["regressions"][0]["key"] == "extra.decode.full_slot.ttft_p99_s"
+        # worst regression leads the report (the fixture's 6.6x tail-FLOPs
+        # blowup outranks the 3.4x TTFT one)
+        assert v["regressions"][0]["key"] == (
+            "extra.decode.prefix_trace.prefill_flops_ratio"
+        )
 
     def test_improvements_and_direction(self):
         base = load_report(BASE)

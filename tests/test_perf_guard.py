@@ -147,9 +147,13 @@ def test_decode_step_reads_kv_proportional_to_active_blocks():
     ))
 
     def bytes_at(n_blocks):
-        cache = create_cache(cfg, slots, n_blocks, block)
+        # paged form: a pool of slots * n_blocks physical blocks (plus
+        # scratch) attended through an n_blocks-wide table — the active
+        # footprint a trace with n_blocks-long rows actually holds
+        cache = create_cache(cfg, slots, 1 + slots * n_blocks, block)
+        table = jnp.zeros((slots, n_blocks), jnp.int32)
         compiled = jax.jit(eng._decode_impl).lower(
-            params, cache, eng.state
+            params, cache, table, eng.state
         ).compile()
         ca = compiled.cost_analysis()
         ca = ca[0] if isinstance(ca, list) else ca

@@ -114,29 +114,35 @@ def test_bucketed_prefill_compile_count(setup):
     eng.run()
     assert eng.metrics.requests_finished == len(lengths)
     assert eng.metrics.prefill_compiles <= 3
-    # decode recompiles only on capacity changes (growth doubling), not per
-    # request: bounded by log2 of the block count
-    assert eng.metrics.decode_compiles <= 1 + int(
-        np.ceil(np.log2(blocks_for(40, 8)))
-    )
+    # decode recompiles only on signature changes — attended table width
+    # (doubling ladder) x pool size (doubling ladder), never per request:
+    # each axis contributes at most 1 + log2 of its block span
+    m_axis = 1 + int(np.ceil(np.log2(blocks_for(40, 8))))
+    p_axis = 1 + int(np.ceil(np.log2(eng._pool_cap)))
+    assert eng.metrics.decode_compiles <= m_axis + p_axis
 
 
 def test_cache_grows_and_frees_blocks(setup):
-    """Capacity tracks the live maximum: it grows in blocks as the longest
-    row extends and shrinks back when that row finishes (freed rows return
-    their blocks)."""
+    """Attended width tracks the live maximum: it grows in blocks as the
+    longest row extends and shrinks back when that row finishes (a freed
+    slot returns the blocks nothing else references)."""
     cfg, params = setup
     eng = Engine(params, cfg, ServeConfig(slots=2, max_len=64, kv_block=8))
     long = eng.submit(Request(prompt=_prompts(cfg, [20], seed=4)[0],
                               max_new_tokens=8))
     first = eng.run()
-    grown = max(c for c in eng._decode_fns)  # capacities the engine compiled
+    # attended table widths the engine compiled (decode signature =
+    # (pool blocks, attended blocks))
+    grown = max(att for _, att in eng._decode_fns) * 8
     assert grown >= 24  # 20-token prompt + decode tail crossed 3 blocks
     # drain left no live rows; a new short request shrinks back to one block
     short = eng.submit(Request(prompt=_prompts(cfg, [3], seed=5)[0],
                                max_new_tokens=2))
     second = eng.run()
-    assert eng.cache.capacity <= 16, eng.cache.capacity
+    assert eng.attended_positions <= 16, eng.attended_positions
+    # the finished rows' private blocks went back to the pool: only the
+    # prefix store's registered blocks (plus scratch) stay referenced
+    assert eng._pool.n_used <= eng._store.n_nodes
     assert first[long].finish_reason == "length"
     assert second[short].finish_reason == "length"
     # run() drains: each call returns (and evicts) only its own completions

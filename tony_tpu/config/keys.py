@@ -201,6 +201,22 @@ class Keys:
     SERVE_GANG_AUTOSCALE_LOW = "serve.gang.autoscale_queue_low"
     SERVE_GANG_AUTOSCALE_WINDOW_S = "serve.gang.autoscale_window_s"
 
+    # --- prefix store (cross-request KV reuse; serve/prefix.py) ---
+    # radix prefix store over the paged KV cache: admission matches each
+    # prompt's longest cached prefix and prefills only the unshared tail;
+    # matched blocks are shared copy-on-write
+    SERVE_PREFIX_ENABLED = "serve.prefix.enabled"
+    # HBM the store may pin for prefixes no live slot references; LRU
+    # leaves evict beyond it (0 = bound only by allocation pressure)
+    SERVE_PREFIX_BUDGET_MB = "serve.prefix.budget_mb"
+    # frontend prefix-affinity routing: requests sharing a prefix
+    # fingerprint route to the host whose store already holds it (falls
+    # back to least-loaded when that host is dead/draining/overloaded)
+    SERVE_PREFIX_AFFINITY = "serve.prefix.affinity"
+    # leading tokens hashed into the routing fingerprint; prompts shorter
+    # than this route purely by load (too little prefix to pin a host for)
+    SERVE_PREFIX_FINGERPRINT_TOKENS = "serve.prefix.fingerprint_tokens"
+
     # --- cluster backend ---
     # Deliberate non-goals vs the reference key surface: docker keys (no
     # container runtime in this environment — processes are the container
@@ -352,6 +368,10 @@ DEFAULTS: dict[str, object] = {
     Keys.SERVE_GANG_AUTOSCALE_HIGH: 0,
     Keys.SERVE_GANG_AUTOSCALE_LOW: 0,
     Keys.SERVE_GANG_AUTOSCALE_WINDOW_S: 10,
+    Keys.SERVE_PREFIX_ENABLED: True,
+    Keys.SERVE_PREFIX_BUDGET_MB: 64,
+    Keys.SERVE_PREFIX_AFFINITY: True,
+    Keys.SERVE_PREFIX_FINGERPRINT_TOKENS: 64,
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",

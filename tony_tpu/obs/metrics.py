@@ -100,7 +100,14 @@ class DecodeMetrics:
     requests_started: int = 0
     requests_finished: int = 0
     prefill_compiles: int = 0      # distinct prefill buckets compiled
-    decode_compiles: int = 0       # distinct cache capacities compiled
+    decode_compiles: int = 0       # distinct pool/table signatures compiled
+    prompt_tokens: int = 0         # prompt tokens admitted
+    prefix_hit_tokens: int = 0     # prompt tokens served from the prefix
+    #                                store (no re-prefill; serve/prefix.py)
+
+    def record_prompt(self, plen: int, hit_tokens: int = 0) -> None:
+        self.prompt_tokens += plen
+        self.prefix_hit_tokens += hit_tokens
 
     def record_prefill(self, dt_s: float, ttft_s: float) -> None:
         self.prefill_s += dt_s
@@ -142,8 +149,14 @@ class DecodeMetrics:
             return 0.0
         return self.ttft_sum_s / self.requests_started
 
+    @property
+    def prefix_hit_rate(self) -> float:
+        if self.prompt_tokens == 0:
+            return 0.0
+        return self.prefix_hit_tokens / self.prompt_tokens
+
     def summary(self) -> dict:
-        return {
+        out = {
             "tokens_per_sec_per_chip": round(self.tokens_per_sec_per_chip, 1),
             "generated_tokens": self.generated_tokens,
             "ttft_avg_s": round(self.ttft_avg_s, 4),
@@ -154,3 +167,7 @@ class DecodeMetrics:
             "prefill_compiles": self.prefill_compiles,
             "decode_compiles": self.decode_compiles,
         }
+        if self.prompt_tokens:
+            out["prefix_hit_tokens"] = self.prefix_hit_tokens
+            out["prefix_hit_rate"] = round(self.prefix_hit_rate, 4)
+        return out

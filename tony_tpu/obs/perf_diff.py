@@ -76,6 +76,14 @@ DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
     (r"top_collective\.bytes", "config", 0.0),
     (r"(overlap_frac|achieved_gbps)", "higher", 0.05),
     (r"(exposed_collective)", "lower", 0.10),
+    # prefix store (serve/prefix.py, bench `decode.prefix_trace`): hit
+    # rate/tokens are higher-better; the TTFT and prefill-FLOPs on/off
+    # ratios are the reuse headline — lower is better, and they must
+    # outrank the memory rule (flops_ratio carries no memory-ish token
+    # but resident bytes do: residency is trace-shaped, skip it)
+    (r"prefix_(hit_rate|hit_tokens)", "higher", 0.05),
+    (r"prefix.*(ttft|flops).*ratio", "lower", 0.10),
+    (r"prefix_(resident|evicted|nodes)", "skip", 0.0),
     # memory: lower is better, generous tolerance (allocator noise)
     (r"(hbm|bytes|_gb$|_mb$|rss)", "lower", 0.10),
     # compile counts: lower is better (a silent recompile regression)

@@ -36,6 +36,7 @@ _VALUE_COLS = (
     ("ttft_p99", "ttft_p99_s", "{:.3f}s"),
     ("queue", "queue_depth", "{:.0f}"),
     ("occup", "occupancy", "{:.2f}"),
+    ("hit%", "prefix_hit_rate", "{:.2f}"),  # prefix-store reuse (serve)
     ("goodput", "goodput_frac", "{:.2f}"),
     ("hbm_gb", "hbm_live_bytes", None),  # formatted specially
 )

@@ -27,6 +27,17 @@ Cache layout is head-major ``[B, n_kv_heads, T, head_dim]`` (the serve
 engine's block cache flattens to exactly this), so the kernel fold is a
 reshape, not a transpose of the whole cache every step.
 
+**Paged form** (``tables`` given): K/V are physical-block *pools*
+``[P, n_kv_heads, block, head_dim]`` and ``tables [B, M]`` maps row ``b``'s
+logical block ``j`` to a physical block id — the indirection that lets the
+prefix store (serve/prefix.py) share one physical block across many rows.
+The scan impl gathers each step's blocks through the table; the pallas impl
+rides the table as a second scalar-prefetch argument whose values steer the
+K/V BlockSpec index map (the grouped_mm tile->expert pattern), so the DMA
+fetches exactly the mapped block. Rows beyond their length still skip their
+FLOPs; table entries beyond a row's allocation must point at a valid id
+(the engine uses the scratch block 0).
+
 No backward: decode is inference-only. ``T`` must be a multiple of
 ``block`` (the block cache guarantees it); ``lengths`` must be >= 1 — the
 engine always writes position ``t`` before attending over ``t + 1``
@@ -101,6 +112,48 @@ def _decode_scan(q, k, v, lengths, *, scale, block):
         ) * scale
         pos = j * block + jnp.arange(block)
         valid = pos[None, :] < lengths[:, None]                # [B, block]
+        s = jnp.where(valid[:, None, None, :], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(valid[:, None, None, :], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bgrk,bgkd->bgrd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l, acc), None
+
+    (m, l, acc), _ = lax.scan(
+        body, (m0, l0, acc0), jnp.arange(nb, dtype=jnp.int32)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(B, H, hd).astype(q.dtype)
+
+
+def _paged_scan(q, k, v, lengths, tables, *, scale):
+    """Online-softmax scan over *logical* blocks, each row's block gathered
+    through its table entry (native GQA contraction, paged pools)."""
+    B, H, hd = q.shape
+    Hkv, blk = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    nb = tables.shape[1]
+    qg = q.reshape(B, Hkv, rep, hd)
+
+    m0 = jnp.full((B, Hkv, rep), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, rep), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, rep, hd), jnp.float32)
+
+    def body(carry, j):
+        m, l, acc = carry
+        pid = lax.dynamic_index_in_dim(tables, j, axis=1, keepdims=False)
+        kb = jnp.take(k, pid, axis=0)                          # [B, Hkv, blk, hd]
+        vb = jnp.take(v, pid, axis=0)
+        s = jnp.einsum(
+            "bgrd,bgkd->bgrk", qg, kb, preferred_element_type=jnp.float32
+        ) * scale
+        pos = j * blk + jnp.arange(blk)
+        valid = pos[None, :] < lengths[:, None]                # [B, blk]
         s = jnp.where(valid[:, None, None, :], s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
@@ -200,6 +253,92 @@ def _decode_pallas(q, k, v, lengths, *, scale, block):
     return out.reshape(B, H, hd)
 
 
+def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc,
+                  l_sc, *, scale, block, kv_heads):
+    i, j = pl.program_id(0), pl.program_id(1)
+    nb = pl.num_programs(1)
+    row_len = len_ref[i // kv_heads]
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    @pl.when(j * block < row_len)
+    def _block():
+        q, k, v = q_ref[0], k_ref[0, 0], v_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                              # [rep, block]
+        pos = j * block + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = pos < row_len
+        s = jnp.where(valid, s, _NEG)
+        m_prev = m_sc[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_sc[:, 0] = l_sc[:, 0] * corr + jnp.sum(p, axis=1)
+        acc[:] = acc[:] * corr[:, None] + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[:, 0] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[:, 0], 1e-30)
+        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k, v, lengths, tables, *, scale):
+    """Grid (B * Hkv, M): the table rides as scalar prefetch and its values
+    steer the K/V BlockSpec index map, so each tile's DMA fetches the
+    physical block the row's table names (no gather materialised)."""
+    B, H, hd = q.shape
+    Hkv, blk = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    nb = tables.shape[1]
+    qf = q.reshape(B * Hkv, rep, hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * Hkv, nb),
+        in_specs=[
+            pl.BlockSpec((1, rep, hd), lambda i, j, ln, tb: (i, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, blk, hd),
+                lambda i, j, ln, tb, kv_heads=Hkv: (
+                    tb[i // kv_heads, j], i % kv_heads, 0, 0
+                ),
+            ),
+            pl.BlockSpec(
+                (1, 1, blk, hd),
+                lambda i, j, ln, tb, kv_heads=Hkv: (
+                    tb[i // kv_heads, j], i % kv_heads, 0, 0
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, rep, hd), lambda i, j, ln, tb: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, hd), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=scale, block=blk, kv_heads=Hkv
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, rep, hd), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=_use_interpret(),
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32), qf, k, v)
+    return out.reshape(B, H, hd)
+
+
 # --- public entry -------------------------------------------------------------
 
 
@@ -209,18 +348,46 @@ def decode_attention(
     v: jax.Array,
     lengths: jax.Array,
     *,
+    tables: jax.Array | None = None,
     impl: str = "scan",
     block: int = 128,
     scale: float | None = None,
 ) -> jax.Array:
     """One decode step of attention at native GQA width.
 
-    q: [B, H, head_dim] (this step's query rows); k/v: [B, Hkv, T, head_dim]
-    head-major caches (T = the active capacity, a multiple of ``block``);
-    lengths: [B] int32 — row b attends positions ``[0, lengths[b])``.
-    Returns [B, H, head_dim].
+    Contiguous form (``tables`` is None): q: [B, H, head_dim] (this step's
+    query rows); k/v: [B, Hkv, T, head_dim] head-major caches (T = the
+    active capacity, a multiple of ``block``); lengths: [B] int32 — row b
+    attends positions ``[0, lengths[b])``. Returns [B, H, head_dim].
+
+    Paged form (``tables [B, M]`` given): k/v are physical-block pools
+    ``[P, Hkv, block, head_dim]`` and row b's logical block j lives at
+    ``tables[b, j]`` — the serve engine's copy-on-write sharing substrate
+    (serve/cache.py, serve/prefix.py). Entries beyond a row's length must
+    still be valid pool ids (the engine points them at the scratch block).
     """
     B, H, hd = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    if impl not in ("scan", "pallas"):
+        raise ValueError(f"unknown decode impl {impl!r} (expected scan | pallas)")
+    if tables is not None:
+        if k.shape != v.shape or k.shape[3] != hd:
+            raise ValueError(
+                f"paged decode_attention shapes q={q.shape} k={k.shape} "
+                f"v={v.shape}"
+            )
+        if tables.shape[0] != B:
+            raise ValueError(
+                f"tables rows {tables.shape[0]} != batch {B}"
+            )
+        if H % k.shape[1]:
+            raise ValueError(
+                f"n_heads {H} not a multiple of n_kv_heads {k.shape[1]}"
+            )
+        if impl == "pallas":
+            return _paged_pallas(q, k, v, lengths, tables, scale=scale)
+        return _paged_scan(q, k, v, lengths, tables, scale=scale)
     if k.shape != v.shape or k.shape[0] != B or k.shape[3] != hd:
         raise ValueError(f"decode_attention shapes q={q.shape} k={k.shape} v={v.shape}")
     Hkv, T = k.shape[1], k.shape[2]
@@ -229,12 +396,8 @@ def decode_attention(
     blk = min(block, T)
     if T % blk:
         raise ValueError(f"cache length {T} must be a multiple of block {blk}")
-    if scale is None:
-        scale = 1.0 / math.sqrt(hd)
     if impl == "pallas":
         return _decode_pallas(q, k, v, lengths, scale=scale, block=blk)
-    if impl != "scan":
-        raise ValueError(f"unknown decode impl {impl!r} (expected scan | pallas)")
     return _decode_scan(q, k, v, lengths, scale=scale, block=blk)
 
 

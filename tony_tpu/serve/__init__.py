@@ -1,14 +1,19 @@
-"""Serving: slot-batched continuous decoding + multi-host inference gangs
-(docs/SERVE.md). serve.gang / serve.frontend are imported directly by
-their users (`tony serve`, the gang worker entrypoint) — not re-exported
-here — so importing the engine surface stays jax-only."""
+"""Serving: slot-batched continuous decoding over a paged, prefix-shared
+KV cache + multi-host inference gangs (docs/SERVE.md). serve.gang /
+serve.frontend are imported directly by their users (`tony serve`, the
+gang worker entrypoint) — not re-exported here — so importing the engine
+surface stays jax-only."""
 
-from tony_tpu.serve.cache import BlockKVCache, create_cache, grow_cache, shrink_cache
+from tony_tpu.serve.cache import (
+    BlockPool, PagedKVCache, create_cache, grow_cache, shrink_cache,
+)
 from tony_tpu.serve.engine import (
     AdmissionRejected, Completion, Engine, Request, ServeConfig,
 )
+from tony_tpu.serve.prefix import PrefixStore
 
 __all__ = [
-    "AdmissionRejected", "BlockKVCache", "Completion", "Engine", "Request",
-    "ServeConfig", "create_cache", "grow_cache", "shrink_cache",
+    "AdmissionRejected", "BlockPool", "Completion", "Engine",
+    "PagedKVCache", "PrefixStore", "Request", "ServeConfig",
+    "create_cache", "grow_cache", "shrink_cache",
 ]

@@ -1,26 +1,37 @@
-"""Length-aware block KV cache for the decode engine.
+"""Paged block KV cache: a refcounted physical-block pool + per-slot
+indirection tables.
 
-generate.py's original ring cache is ``[L, B, max_len, Hkv, hd]``: every
-decode step attends (and every attention DMA walks) the full ``max_len``
-buffer no matter how little of it is written, and a batch admits a request
-only by owning a whole row to ``max_len``. Here the cache is laid out in
-fixed-size **blocks** along the sequence dim and sized to the *active*
-block count:
+The first engine cache was slot-owns-contiguous-blocks: ``[L, S, Hkv, T, hd]``
+with slot ``s`` owning positions ``[0, T)`` of its own row. That layout
+cannot share anything — two requests with the same system/template prefix
+each pay a full prefill and hold duplicate K/V. Here the cache is a **pool**
+of physical blocks plus an indirection map (vLLM's paged KV, arXiv:2309.06180,
+as the substrate for SGLang-style radix prefix sharing, arXiv:2312.07104):
 
-- buffers are ``[L, S, Hkv, T, hd]`` head-major (the decode kernel's native
-  layout — see ops/decode_attention.py) with ``T = n_blocks * block``;
-- ``T`` tracks ``max(ceil(lengths / block))`` over live slots, not
-  ``max_len``: attention cost and cache residency scale with what is
-  actually written (tests/test_perf_guard.py asserts the compiled decode
-  step's KV bytes scale with ``T``);
-- the engine grows ``T`` by doubling when any row fills it (bounded
-  recompiles of the decode step: one per capacity, O(log(max_len/block)))
-  and shrinks it back when the rows holding the tail finish — freed rows
-  return their blocks;
-- per-slot ``lengths`` make the cache ragged-aware: slot ``s`` has valid
-  positions ``[0, lengths[s])``; a freed slot is just ``lengths[s] = 0``
-  (its stale contents are always overwritten before the attended prefix
-  reaches them).
+- buffers are ``[L, P, Hkv, block, hd]`` head-major — ``P`` physical blocks,
+  each holding ``block`` token positions across ALL layers (one allocation =
+  one refcount covering every layer's K and V for that token span);
+- a per-slot **block table** (host-planned, device-threaded through
+  ops/decode_attention.py's scan and pallas impls) maps logical block ``j``
+  of slot ``s`` to a physical block id — slots no longer own contiguous
+  storage, so a physical block can appear in many tables at once;
+- physical block **0 is the scratch block**: never allocated, dead slots'
+  decode writes are steered into it so a freed (and possibly reallocated)
+  block can never be corrupted by a stale slot;
+- :class:`BlockPool` carries the host-side refcounts — a block is shared by
+  construction (live slots + the prefix store each hold a reference) and
+  returns to the free list only when its refcount hits zero, which is what
+  lets ``shrink`` free real HBM without ever reclaiming a block the prefix
+  store still pins;
+- the pool grows by doubling and shrinks by halving (bounded decode-step
+  recompiles, one per pool size), and attention cost scales with the
+  *table width* (active blocks per slot), not ``max_len`` — the
+  tests/test_perf_guard.py contract carries over from the contiguous
+  layout unchanged.
+
+The sharing policy itself (which blocks are safe to share, copy-on-write,
+eviction) lives in serve/prefix.py; this module only knows physical blocks
+and reference counts.
 """
 
 from __future__ import annotations
@@ -31,57 +42,66 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+# physical block 0 is reserved: dead slots' writes land here, and table
+# entries beyond a slot's allocation point at it (their tiles are masked
+# by the per-row length, but the DMA still needs a valid index)
+SCRATCH_BLOCK = 0
 
-class BlockKVCache(NamedTuple):
-    """k/v: [L, S, Hkv, T, hd] with T = n_blocks * block; lengths: [S]."""
+
+class PagedKVCache(NamedTuple):
+    """k/v: [L, P, Hkv, block, hd] physical-block pools; lengths: [S]."""
 
     k: jax.Array
     v: jax.Array
     lengths: jax.Array
 
     @property
-    def capacity(self) -> int:
-        """T — positions currently backed per slot."""
+    def n_blocks(self) -> int:
+        """P — physical blocks currently backed (scratch included)."""
+        return self.k.shape[1]
+
+    @property
+    def block(self) -> int:
+        """Token positions per physical block."""
         return self.k.shape[3]
 
     @property
     def slots(self) -> int:
-        return self.k.shape[1]
+        return self.lengths.shape[0]
 
 
 def create_cache(
     cfg, slots: int, n_blocks: int, block: int, dtype=None
-) -> BlockKVCache:
-    """Fresh cache with ``n_blocks`` blocks per slot."""
-    shape = (
-        cfg.n_layers, slots, cfg.n_kv_heads, n_blocks * block, cfg.head_dim
-    )
+) -> PagedKVCache:
+    """Fresh pool of ``n_blocks`` physical blocks (block 0 = scratch)."""
+    shape = (cfg.n_layers, n_blocks, cfg.n_kv_heads, block, cfg.head_dim)
     dt = dtype or cfg.dtype
-    return BlockKVCache(
+    return PagedKVCache(
         jnp.zeros(shape, dt), jnp.zeros(shape, dt),
         jnp.zeros((slots,), jnp.int32),
     )
 
 
-def grow_cache(cache: BlockKVCache, n_blocks: int, block: int) -> BlockKVCache:
-    """Extend every slot to ``n_blocks`` blocks (zero-filled tail)."""
-    extra = n_blocks * block - cache.capacity
+def grow_cache(cache: PagedKVCache, n_blocks: int) -> PagedKVCache:
+    """Extend the pool to ``n_blocks`` physical blocks (zero-filled)."""
+    extra = n_blocks - cache.n_blocks
     if extra <= 0:
         return cache
-    pad = [(0, 0), (0, 0), (0, 0), (0, extra), (0, 0)]
-    return BlockKVCache(
+    pad = [(0, 0), (0, extra), (0, 0), (0, 0), (0, 0)]
+    return PagedKVCache(
         jnp.pad(cache.k, pad), jnp.pad(cache.v, pad), cache.lengths
     )
 
 
-def shrink_cache(cache: BlockKVCache, n_blocks: int, block: int) -> BlockKVCache:
-    """Release blocks beyond ``n_blocks`` (caller guarantees no live row
-    extends past them — the engine shrinks to the live maximum)."""
-    t = n_blocks * block
-    if t >= cache.capacity:
+def shrink_cache(cache: PagedKVCache, n_blocks: int) -> PagedKVCache:
+    """Release physical blocks beyond ``n_blocks``. The caller guarantees
+    every id >= ``n_blocks`` is FREE (``BlockPool.shrink_target`` reports
+    the lowest safe size — a block pinned by the prefix store or a live
+    slot bounds how far the pool can shrink)."""
+    if n_blocks >= cache.n_blocks:
         return cache
-    return BlockKVCache(
-        cache.k[:, :, :, :t], cache.v[:, :, :, :t], cache.lengths
+    return PagedKVCache(
+        cache.k[:, :n_blocks], cache.v[:, :n_blocks], cache.lengths
     )
 
 
@@ -90,6 +110,111 @@ def blocks_for(length: int, block: int) -> int:
     return max(1, math.ceil(length / block))
 
 
+def block_bytes(cfg, block: int, dtype=None) -> int:
+    """HBM bytes one physical block costs (K + V across all layers)."""
+    dt = jnp.dtype(dtype or cfg.dtype)
+    return 2 * cfg.n_layers * cfg.n_kv_heads * block * cfg.head_dim * dt.itemsize
+
+
+class BlockPool:
+    """Host-side refcounted allocator over physical block ids.
+
+    Pure bookkeeping — no device arrays, no locks (the engine thread is
+    the only mutator; see serve/engine.py). A block id is *live* while its
+    refcount is positive: live slots hold one reference per table entry,
+    and the prefix store holds one per radix node. ``release`` returns a
+    block to the free list only at refcount zero — a freed slot therefore
+    returns only the blocks nothing else (the store, another slot) still
+    references.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("pool needs the scratch block plus one")
+        self._ref = [0] * n_blocks
+        # LIFO free list (reuse-warm blocks first); scratch never enters
+        self._free = list(range(n_blocks - 1, SCRATCH_BLOCK, -1))
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self._ref)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        """Blocks with a positive refcount (scratch excluded)."""
+        return self.n_blocks - 1 - self.n_free
+
+    def alloc(self) -> int | None:
+        """Pop a free block with refcount 1, or None when exhausted (the
+        caller decides whether to grow the pool or evict from the store)."""
+        if not self._free:
+            return None
+        pid = self._free.pop()
+        self._ref[pid] = 1
+        return pid
+
+    def retain(self, pid: int) -> None:
+        if pid == SCRATCH_BLOCK:
+            raise ValueError("cannot retain the scratch block")
+        if self._ref[pid] <= 0:
+            raise ValueError(f"retain of free block {pid}")
+        self._ref[pid] += 1
+
+    def refcount(self, pid: int) -> int:
+        return self._ref[pid]
+
+    def release(self, pid: int) -> bool:
+        """Drop one reference; True when the block returned to the free
+        list (refcount hit zero)."""
+        if pid == SCRATCH_BLOCK:
+            raise ValueError("cannot release the scratch block")
+        if self._ref[pid] <= 0:
+            raise ValueError(f"release of free block {pid}")
+        self._ref[pid] -= 1
+        if self._ref[pid] == 0:
+            self._free.append(pid)
+            return True
+        return False
+
+    def grow(self, n_blocks: int) -> None:
+        """Extend to ``n_blocks`` ids (mirrors :func:`grow_cache`)."""
+        cur = self.n_blocks
+        if n_blocks <= cur:
+            return
+        self._ref.extend([0] * (n_blocks - cur))
+        self._free.extend(range(n_blocks - 1, cur - 1, -1))
+
+    def shrink_target(self, floor: int = 2) -> int:
+        """Lowest pool size every live block still fits in: one past the
+        highest id with a positive refcount. A block pinned high (e.g. by
+        the prefix store) bounds how far :func:`shrink_cache` may go."""
+        for pid in range(self.n_blocks - 1, SCRATCH_BLOCK, -1):
+            if self._ref[pid] > 0:
+                return max(pid + 1, floor)
+        return floor
+
+    def shrink(self, n_blocks: int) -> None:
+        """Drop ids beyond ``n_blocks`` (all must be free — mirrors
+        :func:`shrink_cache`'s contract)."""
+        if n_blocks >= self.n_blocks:
+            return
+        if any(self._ref[pid] > 0 for pid in range(n_blocks, self.n_blocks)):
+            raise ValueError("shrink below a live block")
+        del self._ref[n_blocks:]
+        self._free = [pid for pid in self._free if pid < n_blocks]
+
+
 __all__ = [
-    "BlockKVCache", "blocks_for", "create_cache", "grow_cache", "shrink_cache",
+    "SCRATCH_BLOCK",
+    "BlockPool",
+    "PagedKVCache",
+    "block_bytes",
+    "blocks_for",
+    "create_cache",
+    "grow_cache",
+    "shrink_cache",
 ]

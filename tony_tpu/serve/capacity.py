@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from tony_tpu.models.llama import LlamaConfig, init_params
 from tony_tpu.obs.compiles import aot_analysis
-from tony_tpu.serve.cache import BlockKVCache, blocks_for
+from tony_tpu.serve.cache import PagedKVCache, blocks_for
 
 
 def _param_avals(cfg: LlamaConfig):
@@ -47,13 +47,21 @@ def _tree_bytes(tree) -> int:
     )
 
 
-def _cache_avals(cfg: LlamaConfig, slots: int, capacity: int) -> BlockKVCache:
-    shape = (cfg.n_layers, slots, cfg.n_kv_heads, capacity, cfg.head_dim)
-    return BlockKVCache(
+def _cache_avals(cfg: LlamaConfig, slots: int, capacity: int,
+                 kv_block: int) -> tuple[PagedKVCache, Any]:
+    """Paged pool + table avals sized so every slot reaches ``capacity``
+    positions privately (scratch block included) — the worst case the
+    budget must cover; prefix sharing only ever reduces it."""
+    blocks = blocks_for(capacity, kv_block)
+    n_phys = 1 + slots * blocks
+    shape = (cfg.n_layers, n_phys, cfg.n_kv_heads, kv_block, cfg.head_dim)
+    cache = PagedKVCache(
         k=jax.ShapeDtypeStruct(shape, cfg.dtype),
         v=jax.ShapeDtypeStruct(shape, cfg.dtype),
         lengths=jax.ShapeDtypeStruct((slots,), jnp.int32),
     )
+    table = jax.ShapeDtypeStruct((slots, blocks), jnp.int32)
+    return cache, table
 
 
 def _state_avals(slots: int):
@@ -83,25 +91,42 @@ def decode_step_analysis(cfg: LlamaConfig, *, slots: int, capacity: int,
 
     fn = _decode_fn(cfg, decode_impl, kv_block, max_top_k)
     params = _param_avals(cfg)
-    cache = _cache_avals(cfg, slots, capacity)
-    compiled = fn.lower(params, cache, _state_avals(slots)).compile()
+    cache, table = _cache_avals(cfg, slots, capacity, kv_block)
+    compiled = fn.lower(
+        params, cache, table, _state_avals(slots)
+    ).compile()
+    # per-slot KV bytes: the slot's private blocks (the scratch block is
+    # shared overhead, visible in cache_bytes = the whole pool)
+    blocks = blocks_for(capacity, kv_block)
+    from tony_tpu.serve.cache import block_bytes as _bb
+
     return {
         "slots": slots,
         "capacity": capacity,
         "param_bytes": _tree_bytes(params),
         "cache_bytes": _tree_bytes([cache.k, cache.v]),
+        "table_bytes": _tree_bytes([table]),
+        "kv_bytes_per_slot": blocks * _bb(cfg, kv_block),
         **aot_analysis(compiled),
     }
 
 
 def derive_slot_budget(cfg: LlamaConfig, *, max_len: int,
                        hbm_bytes: int, kv_block: int = 64,
-                       decode_impl: str = "scan") -> dict[str, Any]:
+                       decode_impl: str = "scan",
+                       shared_prefix_tokens: int = 0) -> dict[str, Any]:
     """Slot budget at ``max_len`` from the compiled decode step's
     memory_analysis (params + fixed/per-slot temp + code) instead of the
     old ``hbm * 0.92 - params`` guess. Returns the budget plus every
     component, so a consumer (bench JSON, capacity planning) can see what
-    the chip's HBM actually buys."""
+    the chip's HBM actually buys.
+
+    ``shared_prefix_tokens`` adds the prefix-store accounting
+    (serve/prefix.py): when every request carries that much shared
+    system/template prefix, the shared blocks are paid ONCE (one
+    refcounted physical copy in the pool) and each slot privately holds
+    only its unshared tail — the per-slot marginal KV cost drops by the
+    shared fraction and the slot budget rises accordingly."""
     capacity = blocks_for(max_len, kv_block) * kv_block
     one = decode_step_analysis(
         cfg, slots=1, capacity=capacity, kv_block=kv_block,
@@ -127,8 +152,9 @@ def derive_slot_budget(cfg: LlamaConfig, *, max_len: int,
     fixed_temp = max(temp1 - per_slot_temp, 0)
     code = int(one.get("generated_code_bytes", 0))
     param_bytes = one["param_bytes"]
-    # per-slot KV bytes are exact from the cache aval (k + v for one slot)
-    per_slot_kv = one["cache_bytes"]
+    # per-slot KV bytes are exact from the block math (one slot's private
+    # blocks; the shared scratch block sits in cache_bytes, not here)
+    per_slot_kv = one["kv_bytes_per_slot"]
     # the hypothetical repeat-expanded layout keeps K/V at n_heads width —
     # the capacity the native-GQA decode kernel exists to avoid paying
     per_slot_kv_repeat = per_slot_kv * cfg.n_heads // cfg.n_kv_heads
@@ -138,7 +164,7 @@ def derive_slot_budget(cfg: LlamaConfig, *, max_len: int,
         max(budget // (per_slot_kv_repeat + per_slot_temp), 0)
         if budget > 0 else 0
     )
-    return {
+    out = {
         "hbm_bytes": int(hbm_bytes),
         "param_bytes": int(param_bytes),
         "fixed_temp_bytes": int(fixed_temp),
@@ -150,6 +176,25 @@ def derive_slot_budget(cfg: LlamaConfig, *, max_len: int,
         "max_slots_repeat": int(repeat),
         "source": "memory_analysis",
     }
+    if shared_prefix_tokens > 0:
+        # shared-block accounting: the prefix's blocks exist once in the
+        # pool (refcounted), each slot pays only its unshared tail
+        total_blocks = blocks_for(max_len, kv_block)
+        shared_blocks = min(shared_prefix_tokens // kv_block, total_blocks)
+        per_block = per_slot_kv // total_blocks
+        shared_bytes = shared_blocks * per_block
+        per_slot_private = per_slot_kv - shared_bytes
+        budget_shared = budget - shared_bytes
+        slots_shared = (
+            max(budget_shared // (per_slot_private + per_slot_temp), 0)
+            if budget_shared > 0 and (per_slot_private + per_slot_temp) > 0
+            else 0
+        )
+        out["shared_prefix_tokens"] = int(shared_prefix_tokens)
+        out["shared_prefix_bytes"] = int(shared_bytes)
+        out["kv_bytes_per_slot_prefix_shared"] = int(per_slot_private)
+        out["max_slots_prefix_shared"] = int(slots_shared)
+    return out
 
 
 __all__ = ["decode_step_analysis", "derive_slot_budget"]

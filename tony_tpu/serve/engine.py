@@ -16,10 +16,23 @@ width. This engine replaces all three:
   lengths, so prefill compiles once per bucket (bounded compile count), and
   projects only the prompt's last position through ``lm_head``
   (``forward_with_cache(last_index=...)``).
-- **Length-aware block cache + native-GQA attention.** The KV cache is the
-  block layout of serve/cache.py, sized to the active block count and read
-  by ops/decode_attention.py at native ``n_kv_heads`` width with per-slot
-  lengths — decode cost scales with what is written, not ``max_len``.
+- **Paged block cache + native-GQA attention.** The KV cache is the
+  refcounted physical-block pool of serve/cache.py with per-slot block
+  tables (the engine plans them on the host, the decode step reads K/V
+  through them — ops/decode_attention.py's paged form), sized to the
+  active block count and read at native ``n_kv_heads`` width with
+  per-slot lengths — decode cost scales with what is written, not
+  ``max_len``.
+- **Cross-request prefix reuse.** Admission matches each prompt against
+  the radix prefix store (serve/prefix.py): matched full blocks map
+  shared into the slot's table (refcounted, never written), a mid-block
+  match gets a private copy-on-write block, and prefill computes only
+  the unshared tail — attending the cached prefix K/V gathered from the
+  pool, so TTFT and prefill FLOPs scale with the tail, not the prompt.
+  Tail prefill is bitwise-identical to a full prefill on the same
+  backend (row-independent matmuls + exactly-zero masked softmax terms),
+  so engine-vs-generate parity holds with sharing live
+  (tests/test_prefix.py).
 - **Per-slot state.** Position, EOS, sampling parameters, and an rng stream
   ride per-slot arrays inside the jitted step, so heterogeneous requests
   (different temperatures, eos ids, budgets) share one compiled step. A
@@ -55,8 +68,10 @@ from tony_tpu.obs.metrics import DecodeMetrics
 from tony_tpu.obs.registry import HistogramWindow, Registry, snapshot_to_app_dir
 from tony_tpu.ops.decode_attention import decode_attention
 from tony_tpu.serve.cache import (
-    BlockKVCache, blocks_for, create_cache, grow_cache, shrink_cache,
+    SCRATCH_BLOCK, BlockPool, PagedKVCache, block_bytes, blocks_for,
+    create_cache, grow_cache, shrink_cache,
 )
+from tony_tpu.serve.prefix import MatchResult, PrefixStore
 
 log = logging.getLogger(__name__)
 
@@ -92,6 +107,16 @@ class ServeConfig:
     # work it will serve tail-latency-late. Rejections count into the
     # tony_serve_rejected_total registry counter.
     max_queue: int = 0
+    # cross-request prefix reuse (serve/prefix.py): admission matches each
+    # prompt against the radix store and prefills only the unshared tail;
+    # matched blocks are shared copy-on-write. Off = every request pays a
+    # full prefill (the pre-store behaviour; the paged cache layout is the
+    # same either way).
+    prefix: bool = True
+    # HBM the store may pin for prefixes no live slot references; LRU
+    # leaves evict beyond it (serve.prefix.budget_mb). 0 = bound only by
+    # allocation pressure (the pool cap).
+    prefix_budget_mb: float = 64.0
 
 
 class AdmissionRejected(RuntimeError):
@@ -214,7 +239,8 @@ class Engine:
             slots=serve.slots, max_len=max_len, kv_block=serve.kv_block,
             prefill_buckets=buckets, decode_impl=serve.decode_impl,
             max_top_k=serve.max_top_k, shrink=serve.shrink,
-            max_queue=serve.max_queue,
+            max_queue=serve.max_queue, prefix=serve.prefix,
+            prefix_budget_mb=serve.prefix_budget_mb,
         )
         S = self.serve.slots
         try:
@@ -224,7 +250,39 @@ class Engine:
         except Exception:
             n_chips = 1
         self.metrics = DecodeMetrics(n_chips=n_chips)
-        self.cache = create_cache(cfg, S, 1, self.serve.kv_block)
+        # paged pool + per-slot block tables (serve/cache.py): the table is
+        # planned on the host (np mirror) and uploaded as a [S, attended]
+        # device slice only when it changed — steady-state decode reuses
+        # the cached device copy
+        B = self.serve.kv_block
+        self._m_total = blocks_for(max_len, B)
+        blk_bytes = block_bytes(cfg, B)
+        budget_bytes = int(self.serve.prefix_budget_mb * 2**20)
+        budget_blocks = (
+            max(1, -(-budget_bytes // blk_bytes)) if budget_bytes
+            else S * self._m_total
+        )
+        # the pool never needs more than every slot at max_len plus the
+        # store's budget (plus scratch) — growth stops here, eviction
+        # takes over
+        self._pool_cap = 1 + S * self._m_total + (
+            budget_blocks if self.serve.prefix else 0
+        )
+        p0 = max(2, min(1 + S, self._pool_cap))
+        self._p0 = p0
+        self._pool = BlockPool(p0)
+        self.cache = create_cache(cfg, S, p0, B)
+        self._store: PrefixStore | None = None
+        if self.serve.prefix:
+            self._store = PrefixStore(
+                block=B, block_bytes=blk_bytes, budget_bytes=budget_bytes
+            )
+        self._table = np.zeros((S, self._m_total), np.int32)
+        self._slot_blocks = [0] * S
+        self._attended = 1
+        self._table_dev = jnp.asarray(self._table[:, :1])
+        self._table_dirty = False
+        self._cow_copies = 0
         self.state = _SlotState(
             last_tok=jnp.zeros((S,), jnp.int32),
             rng=jnp.zeros((S, 2), jnp.uint32),
@@ -243,7 +301,8 @@ class Engine:
         self._submit_t: dict[int, float] = {}
         self._next_rid = 0
         self._prefill_fns: dict[int, Any] = {}
-        self._decode_fns: dict[int, Any] = {}
+        self._tail_fns: dict[tuple[int, int], Any] = {}
+        self._decode_fns: dict[tuple[int, int], Any] = {}
         # trace/metrics spine: join the job's trace from the AM-exported
         # env (no-op outside a traced tony-tpu job, idempotent when the
         # user script armed it already), then per-request span handles
@@ -310,6 +369,16 @@ class Engine:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {req.max_new_tokens} "
                 "(prefill always samples the first token)"
+            )
+        if plen >= self.serve.max_len:
+            # explicit and FIRST: an over-long prompt must fail with the
+            # real reason (max_len), deterministically, at submit time —
+            # never reach admission where it would wedge a slot. The gang
+            # worker maps ValueError to a terminal "invalid" chunk, so the
+            # frontend finishes the request instead of replaying it.
+            raise ValueError(
+                f"prompt length {plen} must be shorter than max_len "
+                f"{self.serve.max_len} (at least one generated token must fit)"
             )
         if plen > max(self.serve.prefill_buckets):
             raise ValueError(
@@ -379,6 +448,11 @@ class Engine:
             "requests_finished": float(self._c_finished.value),
             "rejected_total": float(self._c_rejected.value),
         }
+        if self._store is not None:
+            # cross-request reuse health (cumulative): hit rate feeds the
+            # series recorder, the portal, and `tony top`'s hit% column
+            snap.update(self._store.stats())
+            snap["pool_blocks"] = float(self._pool.n_blocks)
         for hist, prefix in (
             (self._h_ttft, "ttft"),
             (self._h_tpot, "tpot"),
@@ -425,6 +499,21 @@ class Engine:
             "tony_serve_rejected_total",
             "submissions rejected by bounded admission (queue at max_queue)",
         )
+        self._c_prefix_hit = reg.counter(
+            "tony_serve_prefix_hit_tokens_total",
+            "prompt tokens served from the prefix store (no re-prefill)",
+        )
+        self._c_prompt_tokens = reg.counter(
+            "tony_serve_prompt_tokens_total",
+            "prompt tokens admitted (the prefix hit-rate denominator)",
+        )
+        self._g_prefix_bytes = reg.gauge(
+            "tony_serve_prefix_resident_bytes",
+            "HBM pinned by prefix-store block references",
+        )
+        self._g_prefix_nodes = reg.gauge(
+            "tony_serve_prefix_nodes", "radix nodes resident in the store",
+        )
 
     def reset_metrics(self) -> None:
         """Fresh throughput/latency counters (e.g. after a warmup trace
@@ -434,7 +523,7 @@ class Engine:
         blend warmup compile time into the measured trace."""
         self.metrics = DecodeMetrics(
             n_chips=self.metrics.n_chips,
-            prefill_compiles=len(self._prefill_fns),
+            prefill_compiles=len(self._prefill_fns) + len(self._tail_fns),
             decode_compiles=len(self._decode_fns),
         )
         self._init_registry()
@@ -471,6 +560,12 @@ class Engine:
         # the backend really compiled) and the engine-scoped peak-HBM
         # watermark (marked at __init__, measured by the attribution rule)
         s["xla_compiles"] = self._ledger.backend_compiles - self._compiles_t0
+        if self._store is not None:
+            # prefix-store lifetime summary: hit rate is the reuse headline,
+            # cow_copies the sharing-safety one (each is a block the store
+            # protected from a would-be shared write)
+            s["prefix"] = dict(self._store.stats())
+            s["prefix"]["cow_copies"] = self._cow_copies
         sentinel = health.active_sentinel()
         if sentinel is not None:
             # drain so a trip on the final decode steps reaches the summary,
@@ -594,22 +689,45 @@ class Engine:
         prompt = np.asarray(jax.device_get(req.prompt), np.int32).reshape(-1)
         plen = len(prompt)
         bucket = self._bucket_for(plen)
-        with trace.span("serve.prefill", rid=rid, bucket=bucket, slot=slot):
-            self._ensure_capacity(max(bucket, plen + 1))
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :plen] = prompt
+        # prefix match: pure host-side hashing on the admission path (no
+        # device work, GL001-clean). A match is used only when it covers at
+        # least one full block — shorter overlaps would pay a COW block
+        # copy for near-zero prefill savings.
+        match: MatchResult | None = None
+        matched = 0
+        if self._store is not None and plen > 1:
+            m = self._store.match(prompt.tolist(), plen - 1)
+            if m.full:
+                match = self._trim_match(plen, m)
+                matched = match.length
+            self._store.record_prompt(plen, matched)
+            self._c_prompt_tokens.inc(plen)
+            if matched:
+                self._c_prefix_hit.inc(matched)
+        self.metrics.record_prompt(plen, matched)
+        with trace.span("serve.prefill", rid=rid, bucket=bucket, slot=slot,
+                        matched=matched):
+            self._plan_blocks(slot, plen, match)
             key = _as_raw_key(req.rng, rid)
-            # ledger attribution: a fresh bucket compile fired inside this
-            # call journals under the prefill's name, not anonymously
-            with self._ledger.label(f"serve.prefill[{bucket}]"):
-                tok, carry, pk, pv = self._get_prefill(bucket)(
-                    self.params, jnp.asarray(padded), jnp.int32(plen - 1),
-                    jnp.float32(req.temperature), jnp.int32(req.top_k),
-                    jnp.float32(req.top_p), key,
-                )
+            if match is None:
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :plen] = prompt
+                # ledger attribution: a fresh bucket compile fired inside
+                # this call journals under the prefill's name, not
+                # anonymously
+                with self._ledger.label(f"serve.prefill[{bucket}]"):
+                    tok, carry, pk, pv = self._get_prefill(bucket)(
+                        self.params, jnp.asarray(padded), jnp.int32(plen - 1),
+                        jnp.float32(req.temperature), jnp.int32(req.top_k),
+                        jnp.float32(req.top_p), key,
+                    )
+                self._scatter_prompt(slot, pk, pv, 0, plen)
+            else:
+                tok, carry = self._tail_prefill(slot, prompt, matched, req, key)
             # EXPLICIT sync: the sampled first token steers admission on
             # the host (transfer-guard-clean under GRAFT_SANITIZE)
             tok = int(jax.device_get(tok))
+        self._register_prompt(slot, prompt)
         now = time.perf_counter()
         self.metrics.record_prefill(now - t0, now - self._submit_t[rid])  # popped below
         self._h_ttft.observe(now - self._submit_t[rid])
@@ -620,9 +738,6 @@ class Engine:
             # decode-lifetime span: first token -> finish
             self._decode_spans[rid] = tracer.span("serve.decode", rid=rid, slot=slot)
 
-        self.cache = _insert_fn()(
-            self.cache, pk, pv, jnp.int32(slot), jnp.int32(plen)
-        )
         self._slot_len[slot] = plen
         st = self.state
         eos = -1 if req.eos_id is None else int(req.eos_id)
@@ -675,59 +790,262 @@ class Engine:
         self.cache = self.cache._replace(
             lengths=self.cache.lengths.at[slot].set(0)
         )
+        # a freed slot returns only the blocks whose refcount hits zero —
+        # blocks the prefix store (or another slot's table) still
+        # references stay resident
+        row = self._table[slot]
+        for bi in range(self._slot_blocks[slot]):
+            self._pool.release(int(row[bi]))
+        row[:self._slot_blocks[slot]] = SCRATCH_BLOCK
+        self._slot_blocks[slot] = 0
+        self._table_dirty = True
+        self._maybe_shrink_pool()
 
-    # --- capacity -------------------------------------------------------------
+    # --- block planning (host side of the paged cache) ------------------------
 
-    def _ensure_capacity(self, min_positions: int) -> None:
-        """Grow (doubling) so every live row + ``min_positions`` fits; shrink
-        when the live maximum has fallen to half the capacity or less."""
-        block = self.serve.kv_block
-        live_max = max(
-            [min_positions]
-            + [self._slot_len[s] + 1 for s, r in enumerate(self._slot_rid) if r is not None]
+    @property
+    def attended_positions(self) -> int:
+        """Positions the decode step currently attends per slot (table
+        width x kv_block) — the paged analogue of the old contiguous
+        cache's ``capacity``."""
+        return self._attended * self.serve.kv_block
+
+    def _alloc_block(self) -> int:
+        """One private physical block: free list, else grow the pool
+        (doubling, device + host in lockstep), else evict LRU leaves from
+        the prefix store until a block frees. The pool cap covers every
+        slot at max_len plus the store budget, so the chain terminates."""
+        pid = self._pool.alloc()
+        while pid is None:
+            if self._pool.n_blocks < self._pool_cap:
+                new = min(max(2 * self._pool.n_blocks, 4), self._pool_cap)
+                self.cache = grow_cache(self.cache, new)
+                self._pool.grow(new)
+            elif self._store is not None and \
+                    self._store.evict_lru(self._pool.release) is not None:
+                pass  # evicted; the release may or may not have freed HBM
+            else:
+                raise RuntimeError(
+                    "block pool exhausted (live slots + store exceed the "
+                    "pool cap — engine accounting bug)"
+                )
+            pid = self._pool.alloc()
+        return pid
+
+    def _plan_blocks(self, slot: int, plen: int, match: MatchResult | None) -> None:
+        """Fill the slot's table row for a prompt: matched full blocks map
+        shared (one pool reference each, never written), a mid-block match
+        gets a private copy-on-write block, the rest are fresh."""
+        B = self.serve.kv_block
+        row = self._table[slot]
+        nb = blocks_for(plen, B)
+        next_bi = 0
+        if match is not None:
+            for bi, pid in enumerate(match.full):
+                self._pool.retain(pid)
+                row[bi] = pid
+            next_bi = len(match.full)
+            if match.partial is not None:
+                # COW: the unshared tail writes into this block — hand the
+                # slot a private copy of the shared source first
+                dst = self._alloc_block()
+                self.cache = _copy_block_fn()(
+                    self.cache, jnp.int32(match.partial), jnp.int32(dst)
+                )
+                row[next_bi] = dst
+                next_bi += 1
+                self._cow_copies += 1
+        for bi in range(next_bi, nb):
+            row[bi] = self._alloc_block()
+        self._slot_blocks[slot] = nb
+        self._table_dirty = True
+
+    def _trim_match(self, plen: int, match: MatchResult) -> MatchResult:
+        """Drop a mid-block (COW) match when the tail's ladder bucket
+        would overrun the cache cap: with the match cut back to its full
+        blocks the tail starts block-aligned, so the block-aligned tail
+        width always fits — tail-prefill signatures stay multiples of
+        kv_block instead of one per match length."""
+        B = self.serve.kv_block
+        if match.partial is None:
+            return match
+        tb = self._bucket_for(plen - match.length)
+        if match.length + tb <= self._m_total * B:
+            return match
+        return MatchResult(len(match.full) * B, match.full, None)
+
+    def _scatter_prompt(self, slot: int, pk, pv, start: int, plen: int) -> None:
+        """Write prefilled K/V (``[L, Hkv, W, hd]``, positions ``start +
+        i``) into the slot's blocks; padded rows beyond ``plen`` steer to
+        the scratch block."""
+        B = self.serve.kv_block
+        row = self._table[slot]
+        W = pk.shape[2]
+        p = start + np.arange(W)
+        valid = p < plen
+        pids = np.where(valid, row[np.minimum(p // B, self._m_total - 1)],
+                        SCRATCH_BLOCK).astype(np.int32)
+        offs = np.where(valid, p % B, 0).astype(np.int32)
+        self.cache = _scatter_fn()(
+            self.cache, pk, pv, jnp.asarray(pids), jnp.asarray(offs),
+            jnp.int32(slot), jnp.int32(plen),
         )
-        need = blocks_for(live_max, block)
-        cap_blocks = blocks_for(self.serve.max_len, block)
-        cur = self.cache.capacity // block
+
+    def _tail_prefill(self, slot: int, prompt: np.ndarray, matched: int,
+                      req: Request, key):
+        """Prefill only the unshared tail: gather the matched prefix K/V
+        from the pool (through the slot's own table, COW copy included)
+        into a contiguous context, run the tail bucket through the model
+        attending it, and scatter the tail K/V back into the slot's
+        private blocks. FLOPs scale with the tail, not the prompt."""
+        B = self.serve.kv_block
+        plen = len(prompt)
+        tail_len = plen - matched
+        cap = self._m_total * B
+        tb = self._bucket_for(tail_len)
+        if matched + tb > cap:
+            # the ladder bucket overruns the cache cap (long match, coarse
+            # ladder): fall back to the block-aligned minimum. _trim_match
+            # guaranteed `matched` is block-aligned in this case, so
+            # matched + ceil(tail/B)*B = ceil(plen/B)*B <= cap always —
+            # signatures stay multiples of kv_block, never one per match
+            tb = blocks_for(tail_len, B) * B
+            assert matched % B == 0 and matched + tb <= cap, (matched, tb)
+        # context width: enough for prefix + padded tail, rounded to a
+        # power-of-two block count (bounded compile signatures)
+        nC = blocks_for(max(plen, matched + tb), B)
+        p2 = 1
+        while p2 < nC:
+            p2 *= 2
+        nC = min(p2, self._m_total)
+        C = nC * B
+        row = self._table[slot]
+        n_have = blocks_for(plen, B)
+        gather = np.full(nC, SCRATCH_BLOCK, np.int32)
+        gather[:min(n_have, nC)] = row[:min(n_have, nC)]
+        ctx_k, ctx_v = _gather_fn()(self.cache, jnp.asarray(gather))
+        tail = np.zeros((1, tb), np.int32)
+        tail[0, :tail_len] = prompt[matched:]
+        with self._ledger.label(f"serve.prefill_tail[{tb},{C}]"):
+            tok, carry, tk, tv = self._get_tail_prefill(tb, C)(
+                self.params, ctx_k, ctx_v, jnp.asarray(tail),
+                jnp.int32(matched), jnp.int32(tail_len - 1),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p), key,
+            )
+        self._scatter_prompt(slot, tk, tv, matched, plen)
+        return tok, carry
+
+    def _register_prompt(self, slot: int, prompt: np.ndarray) -> None:
+        """Insert the prompt's full blocks into the prefix store (each new
+        radix node takes its own pool reference), then evict back under
+        the HBM budget."""
+        if self._store is None:
+            return
+        B = self.serve.kv_block
+        n_full = len(prompt) // B
+        if n_full:
+            self._store.insert(
+                prompt[:n_full * B].tolist(),
+                self._table[slot, :n_full].tolist(), self._pool.retain,
+            )
+            if self._store.evict_to_budget(self._pool.release):
+                self._maybe_shrink_pool()
+        self._g_prefix_bytes.set(self._store.resident_bytes)
+        self._g_prefix_nodes.set(self._store.n_nodes)
+
+    def _maybe_shrink_pool(self) -> None:
+        """Halve the pool while the trailing half is entirely free — a
+        block pinned high (prefix store or a long-lived slot) bounds the
+        shrink, exactly the refcount contract shrink_cache documents."""
+        if not self.serve.shrink:
+            return
+        new = self._pool.n_blocks
+        target = self._pool.shrink_target(self._p0)
+        while new // 2 >= target and new // 2 >= self._p0:
+            new //= 2
+        if new < self._pool.n_blocks:
+            self.cache = shrink_cache(self.cache, new)
+            self._pool.shrink(new)
+
+    def _set_attended(self, need: int) -> None:
+        """Size the decode step's table width to the live maximum: grow by
+        doubling, shrink when the need halves (the old contiguous-capacity
+        policy, now on the indirection table)."""
+        cur = self._attended
         if need > cur:
-            new = min(max(need, 2 * cur), cap_blocks)
-            self.cache = grow_cache(self.cache, new, block)
+            cur = min(max(need, 2 * cur), self._m_total)
         elif self.serve.shrink and need <= cur // 2:
-            self.cache = shrink_cache(self.cache, need, block)
+            cur = max(need, 1)
+        if cur != self._attended or self._table_dirty:
+            self._attended = cur
+            self._table_dev = jnp.asarray(self._table[:, :cur])
+            self._table_dirty = False
 
     # --- jitted steps ---------------------------------------------------------
 
     def _get_prefill(self, bucket: int):
         if bucket not in self._prefill_fns:
-            self._prefill_fns[bucket] = _prefill_fn(
-                self.cfg, bucket, self.serve.max_top_k
+            # AOT-compiled (module-wide cache) so the compile ledger holds
+            # the prefill's measured cost_analysis FLOPs — the number the
+            # bench/acceptance gate compares against the tail prefill's to
+            # prove FLOPs scale with the unshared tail
+            self._prefill_fns[bucket] = _aot_prefill(
+                self.cfg, bucket, self.serve.max_top_k, self.params,
+                self._ledger,
             )
-            self.metrics.prefill_compiles = len(self._prefill_fns)
+            self.metrics.prefill_compiles = (
+                len(self._prefill_fns) + len(self._tail_fns)
+            )
         return self._prefill_fns[bucket]
 
-    def _get_decode(self, capacity: int):
-        if capacity not in self._decode_fns:
+    def _get_tail_prefill(self, tb: int, ctx: int):
+        if (tb, ctx) not in self._tail_fns:
+            self._tail_fns[(tb, ctx)] = _aot_tail_prefill(
+                self.cfg, tb, ctx, self.serve.max_top_k, self.params,
+                self._ledger,
+            )
+            self.metrics.prefill_compiles = (
+                len(self._prefill_fns) + len(self._tail_fns)
+            )
+        return self._tail_fns[(tb, ctx)]
+
+    def _get_decode(self, signature: tuple[int, int]):
+        if signature not in self._decode_fns:
             # AOT-compiled per (model, kernel, shapes, sharding), shared
             # across engines module-wide (_aot_decode's cache — every
-            # capacity/slot-count signature compiles once per process, not
-            # once per Engine); the AOT executable is what lets the ledger
-            # record the decode step's measured memory plan
-            # (memory_analysis: params + temp + per-slot KV bytes), which
+            # pool-size/table-width signature compiles once per process,
+            # not once per Engine); the AOT executable is what lets the
+            # ledger record the decode step's measured memory plan
+            # (memory_analysis: params + temp + per-block KV bytes), which
             # the gqa_capacity slot budget is derived from. The per-engine
-            # dict only counts the distinct capacities this engine entered.
-            self._decode_fns[capacity] = _aot_decode(
+            # dict only counts the distinct signatures this engine entered.
+            self._decode_fns[signature] = _aot_decode(
                 self.cfg, self.serve.decode_impl, self.serve.kv_block,
-                self.serve.max_top_k, self.params, self.cache, self.state,
-                self._ledger, monitors=self._monitors,
+                self.serve.max_top_k, self.params, self.cache,
+                self._table_dev, self.state, self._ledger,
+                monitors=self._monitors,
             )
             self.metrics.decode_compiles = len(self._decode_fns)
-        return self._decode_fns[capacity]
+        return self._decode_fns[signature]
 
     # --- decode loop ----------------------------------------------------------
 
     def _decode_once(self) -> None:
-        self._ensure_capacity(1)
+        # per-step block planning: a live row whose write position starts
+        # a new block gets one allocated NOW (host-side, before dispatch);
+        # the attended table width tracks the live maximum
+        B = self.serve.kv_block
         live_before = [s for s, r in enumerate(self._slot_rid) if r is not None]
+        need = 1
+        for s in live_before:
+            pos = self._slot_len[s]
+            if pos % B == 0 and self._slot_blocks[s] == pos // B:
+                self._table[s, pos // B] = self._alloc_block()
+                self._slot_blocks[s] += 1
+                self._table_dirty = True
+            need = max(need, pos // B + 1)
+        self._set_attended(need)
         tracer = trace.active_tracer()
         sp = trace.NOOP_SPAN
         if tracer is not None:
@@ -735,8 +1053,8 @@ class Engine:
         with sp:
             t0 = time.perf_counter()
             self.cache, self.state, toks, hmon = self._get_decode(
-                self.cache.capacity
-            )(self.params, self.cache, self.state)
+                (self.cache.n_blocks, self._attended)
+            )(self.params, self.cache, self._table_dev, self.state)
             # EXPLICIT per-step sync: continuous batching needs the sampled
             # tokens + done flags on host to steer admission — this is the
             # engine's one designed sync point per decode step
@@ -767,11 +1085,11 @@ class Engine:
             elif self._slot_remaining[s] <= 0:
                 self._finish(s, "length")
 
-    def _decode_impl(self, params, cache: BlockKVCache, state: _SlotState):
+    def _decode_impl(self, params, cache: PagedKVCache, table, state: _SlotState):
         """One token for every slot (test/guard hook; the hot path goes
         through the module-level cache in :func:`_decode_fn`)."""
         return _decode_step(
-            params, cache, state, cfg=self.cfg,
+            params, cache, table, state, cfg=self.cfg,
             decode_impl=self.serve.decode_impl,
             kv_block=self.serve.kv_block, max_top_k=self.serve.max_top_k,
             monitors=self._monitors,
@@ -788,74 +1106,174 @@ def _prefill_fn(cfg: LlamaConfig, bucket: int, max_top_k: int):
 
 
 @functools.lru_cache(maxsize=512)
+def _tail_fn(cfg: LlamaConfig, tb: int, max_top_k: int):
+    """Jitted tail prefill (prefix-matched admissions), cached per (model
+    config, tail bucket); jit itself caches per context width."""
+    return jax.jit(partial(
+        _tail_prefill_step, cfg=cfg, tb=tb, max_top_k=max_top_k
+    ))
+
+
+@functools.lru_cache(maxsize=512)
 def _decode_fn(cfg: LlamaConfig, decode_impl: str, kv_block: int,
                max_top_k: int, monitors: bool = False):
     """Jitted decode step, cached per (model config, kernel knobs) — NOT
-    per capacity/slots: jit itself caches per argument shape, so all
-    engines with the same model reuse every compiled signature."""
+    per pool-size/table-width: jit itself caches per argument shape, so
+    all engines with the same model reuse every compiled signature. The
+    block table (arg 2) is NOT donated — it is reused across steps."""
     return jax.jit(
         partial(
             _decode_step, cfg=cfg, decode_impl=decode_impl,
             kv_block=kv_block, max_top_k=max_top_k, monitors=monitors,
         ),
-        donate_argnums=(1, 2),
+        donate_argnums=(1, 3),
     )
 
 
-# AOT decode executables shared module-wide: keyed by model/kernel knobs +
-# the cache/state shapes + the params' sharding, so engines with the same
+# AOT executables shared module-wide: keyed by model/kernel knobs + the
+# cache/state shapes + the params' sharding, so engines with the same
 # model reuse every compiled signature (the lru_cache-on-jit property the
 # lazy path had), while the AOT form exposes memory_analysis()/
 # cost_analysis() to the compile ledger and serve/capacity.py
 _aot_decode_cache: dict = {}
+_aot_prefill_cache: dict = {}
+
+
+def _aot_compile(fn, avals, key, name, ledger, cache=_aot_prefill_cache):
+    """Shared AOT-with-ledger path: lower from ``avals`` (live arrays or
+    ShapeDtypeStructs), journal the measured cost/memory plan under
+    ``name``, fall back to lazy jit dispatch on any compile failure."""
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    t0 = time.perf_counter()
+    try:
+        with ledger.label(name):
+            compiled = fn.lower(*avals).compile()
+        ledger.record_aot(name, compiled, time.perf_counter() - t0)
+    except Exception:
+        log.debug("AOT compile of %s failed; using lazy jit", name,
+                  exc_info=True)
+        compiled = fn
+    if len(cache) < 512:
+        cache[key] = compiled
+    return compiled
 
 
 def _aot_decode(cfg: LlamaConfig, decode_impl: str, kv_block: int,
-                max_top_k: int, params, cache, state, ledger, *,
+                max_top_k: int, params, cache, table, state, ledger, *,
                 monitors: bool = False):
     fn = _decode_fn(cfg, decode_impl, kv_block, max_top_k, monitors)
     try:
         shard = jax.tree.leaves(params)[0].sharding
         key = (cfg, decode_impl, kv_block, max_top_k, monitors,
-               cache.k.shape, str(cache.k.dtype), hash(shard), shard)
+               cache.k.shape, str(cache.k.dtype), table.shape,
+               hash(shard), shard)
     except Exception:
         # unhashable sharding (exotic platform): lazy jit still works and
         # still shares compiles process-wide
         return fn
-    hit = _aot_decode_cache.get(key)
-    if hit is not None:
-        return hit
-    t0 = time.perf_counter()
-    capacity = cache.k.shape[3]
-    name = f"serve.decode[slots={cache.k.shape[1]},cap={capacity}]"
+    name = (f"serve.decode[slots={state.last_tok.shape[0]},"
+            f"blocks={cache.k.shape[1]},attended={table.shape[1]}]")
+    return _aot_compile(
+        fn, (params, cache, table, state), key, name, ledger,
+        cache=_aot_decode_cache,
+    )
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _aot_prefill(cfg: LlamaConfig, bucket: int, max_top_k: int, params,
+                 ledger):
+    """Full-prompt prefill, AOT so the ledger records its cost_analysis
+    FLOPs (the full-prompt baseline the prefix store's tail-FLOPs are
+    judged against)."""
+    fn = _prefill_fn(cfg, bucket, max_top_k)
     try:
-        with ledger.label(name):
-            compiled = fn.lower(params, cache, state).compile()
-        ledger.record_aot(name, compiled, time.perf_counter() - t0)
+        shard = jax.tree.leaves(params)[0].sharding
+        key = ("prefill", cfg, bucket, max_top_k, hash(shard), shard)
     except Exception:
-        log.debug("AOT decode compile failed; using lazy jit dispatch",
-                  exc_info=True)
-        compiled = fn
-    if len(_aot_decode_cache) < 512:
-        _aot_decode_cache[key] = compiled
-    return compiled
+        return fn
+    # live params (their real shardings bake into the executable — a
+    # sharded-params engine must not compile against default layouts),
+    # avals for the per-call scalars
+    avals = (
+        params, _sds((1, bucket), jnp.int32), _sds((), jnp.int32),
+        _sds((), jnp.float32), _sds((), jnp.int32), _sds((), jnp.float32),
+        _sds((2,), jnp.uint32),
+    )
+    return _aot_compile(fn, avals, key, f"serve.prefill[{bucket}]", ledger)
+
+
+def _aot_tail_prefill(cfg: LlamaConfig, tb: int, ctx: int, max_top_k: int,
+                      params, ledger):
+    fn = _tail_fn(cfg, tb, max_top_k)
+    try:
+        shard = jax.tree.leaves(params)[0].sharding
+        key = ("tail", cfg, tb, ctx, max_top_k, hash(shard), shard)
+    except Exception:
+        return fn
+    kv = _sds((cfg.n_layers, 1, ctx, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+    avals = (
+        params, kv, kv, _sds((1, tb), jnp.int32), _sds((), jnp.int32),
+        _sds((), jnp.int32), _sds((), jnp.float32), _sds((), jnp.int32),
+        _sds((), jnp.float32), _sds((2,), jnp.uint32),
+    )
+    return _aot_compile(
+        fn, avals, key, f"serve.prefill_tail[{tb},{ctx}]", ledger
+    )
 
 
 @functools.lru_cache(maxsize=1)
-def _insert_fn():
-    """Jitted prefill-KV insert with a DONATED cache: the un-jitted
-    ``.at[...].set`` form dispatched two whole-cache device copies per
-    admission (the old buffers stay referenced, so XLA cannot update in
-    place) — O(cache) instead of O(bucket) work every admit."""
-    def insert(cache: BlockKVCache, pk, pv, slot, plen):
-        k = lax.dynamic_update_slice(cache.k, pk[:, None], (0, slot, 0, 0, 0))
-        v = lax.dynamic_update_slice(cache.v, pv[:, None], (0, slot, 0, 0, 0))
-        lengths = lax.dynamic_update_slice(
-            cache.lengths, plen[None], (slot,)
-        )
-        return BlockKVCache(k, v, lengths)
+def _scatter_fn():
+    """Jitted position-wise KV scatter into the (DONATED) pool: position
+    ``i`` of the prefilled span lands in physical block ``pids[i]`` at
+    offset ``offs[i]``; masked rows steer to the scratch block. One
+    in-place scatter instead of two whole-cache copies per admission."""
+    def insert(cache: PagedKVCache, pk, pv, pids, offs, slot, plen):
+        # pk/pv [L, Hkv, W, hd]; advanced indices (pids axis 1, offs axis
+        # 3) are non-adjacent, so the indexed result moves to the front:
+        # [W, L, Hkv, hd] — match it by transposing the span
+        k = cache.k.at[:, pids, :, offs, :].set(pk.transpose(2, 0, 1, 3))
+        v = cache.v.at[:, pids, :, offs, :].set(pv.transpose(2, 0, 1, 3))
+        lengths = lax.dynamic_update_slice(cache.lengths, plen[None], (slot,))
+        return PagedKVCache(k, v, lengths)
 
     return jax.jit(insert, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=1)
+def _copy_block_fn():
+    """Jitted copy-on-write block copy (DONATED pool): duplicate one
+    physical block (all layers, K and V) so a slot about to write into a
+    shared block writes into its private copy instead."""
+    def cp(cache: PagedKVCache, src, dst):
+        kb = lax.dynamic_slice_in_dim(cache.k, src, 1, axis=1)
+        vb = lax.dynamic_slice_in_dim(cache.v, src, 1, axis=1)
+        k = lax.dynamic_update_slice_in_dim(cache.k, kb, dst, axis=1)
+        v = lax.dynamic_update_slice_in_dim(cache.v, vb, dst, axis=1)
+        return PagedKVCache(k, v, cache.lengths)
+
+    return jax.jit(cp, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=1)
+def _gather_fn():
+    """Jitted prefix gather: pool blocks ``pids`` -> one contiguous
+    ``[L, 1, C, Hkv, hd]`` context cache for the tail prefill (read-only:
+    the pool is NOT donated — the slot keeps serving from it)."""
+    def gat(cache: PagedKVCache, pids):
+        def one(pool):
+            g = jnp.take(pool, pids, axis=1)           # [L, nC, Hkv, blk, hd]
+            L, nC, Hkv, blk, hd = g.shape
+            return g.transpose(0, 1, 3, 2, 4).reshape(
+                L, nC * blk, Hkv, hd
+            )[:, None]                                 # [L, 1, C, Hkv, hd]
+        return one(cache.k), one(cache.v)
+
+    return jax.jit(gat)
 
 
 def _prefill_step(params, prompt, last_index, temp, top_k, top_p, key, *,
@@ -879,14 +1297,45 @@ def _prefill_step(params, prompt, last_index, temp, top_k, top_p, key, *,
     return tok, carry, pk, pv
 
 
-def _decode_step(params, cache: BlockKVCache, state: _SlotState, *,
+def _tail_prefill_step(params, ctx_k, ctx_v, tail, start, last_index, temp,
+                       top_k, top_p, key, *, cfg: LlamaConfig, tb: int,
+                       max_top_k: int):
+    """Prefill only the unshared tail of a prefix-matched prompt: the
+    gathered prefix K/V (``[L, 1, C, Hkv, hd]``, positions ``[0, start)``
+    valid) is the attention context, the tail bucket runs from absolute
+    position ``start``, and only the prompt's true last position projects
+    through lm_head. Bitwise-identical to the full prefill's logits —
+    forward_with_cache masks by absolute position and every masked term is
+    exactly zero."""
+    from tony_tpu.models.generate import (
+        KVCache, forward_with_cache, sample_tokens,
+    )
+
+    logits, kv = forward_with_cache(
+        params, tail, KVCache(ctx_k, ctx_v), start, cfg,
+        last_index=last_index,
+    )
+    use, carry = jax.random.split(key)
+    tok = sample_tokens(
+        logits[:, 0], temp[None], top_k[None], top_p[None], use[None],
+        max_k=max_top_k,
+    )[0]
+    # the tail's K/V, head-major [L, Hkv, tb, hd], for the block scatter
+    tk = lax.dynamic_slice_in_dim(kv.k[:, 0], start, tb, axis=1)
+    tv = lax.dynamic_slice_in_dim(kv.v[:, 0], start, tb, axis=1)
+    return tok, carry, tk.transpose(0, 2, 1, 3), tv.transpose(0, 2, 1, 3)
+
+
+def _decode_step(params, cache: PagedKVCache, table, state: _SlotState, *,
                  cfg: LlamaConfig, decode_impl: str, kv_block: int,
                  max_top_k: int, monitors: bool = False):
-    """One token for every slot: write K/V at each row's position, attend
-    over its written prefix, sample with its own stream. ``monitors``
-    additionally returns the fused per-slot health monitors (logits
-    nonfinite counts + sampling entropy, obs/health.py); the dict is empty
-    when disarmed so the signature stays stable."""
+    """One token for every slot: write K/V at each row's position (into
+    the physical block its table names — dead slots steer to the scratch
+    block so a freed, possibly reallocated block can never be corrupted),
+    attend over its written prefix through the table, sample with its own
+    stream. ``monitors`` additionally returns the fused per-slot health
+    monitors (logits nonfinite counts + sampling entropy, obs/health.py);
+    the dict is empty when disarmed so the signature stays stable."""
     from tony_tpu.models.generate import sample_tokens
 
     S = state.last_tok.shape[0]
@@ -903,25 +1352,34 @@ def _decode_step(params, cache: BlockKVCache, state: _SlotState, *,
             [t1 * cos - t2 * sin, t2 * cos + t1 * sin], axis=-1
         ).astype(t.dtype)
 
-    def write(c, new, p):  # c [Hkv, T, hd], new [Hkv, hd], p scalar
-        return lax.dynamic_update_slice(c, new[:, None, :], (0, p, 0))
+    # paged write target: row s's position lands in physical block
+    # table[s, pos // block] at offset pos % block
+    bi = pos // kv_block
+    off = pos % kv_block
+    pid = jnp.where(
+        state.live,
+        jnp.take_along_axis(table, bi[:, None], axis=1)[:, 0],
+        SCRATCH_BLOCK,
+    )
 
     def block(x, layer):
-        lp, k_cache, v_cache = layer
+        lp, k_pool, v_pool = layer
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = rope((h @ lp["wq"]).reshape(S, H, hd))
         k_new = rope((h @ lp["wk"]).reshape(S, Hkv, hd))
         v_new = (h @ lp["wv"]).reshape(S, Hkv, hd)
-        k_cache = jax.vmap(write)(k_cache, k_new, pos)
-        v_cache = jax.vmap(write)(v_cache, v_new, pos)
+        # per-row scatter into the pool (advanced indices pid/off move the
+        # row dim to the front: the slice value is [S, Hkv, hd] directly)
+        k_pool = k_pool.at[pid, :, off, :].set(k_new)
+        v_pool = v_pool.at[pid, :, off, :].set(v_new)
         attn = decode_attention(
-            q, k_cache, v_cache, pos + 1,
+            q, k_pool, v_pool, pos + 1, tables=table,
             impl=decode_impl, block=kv_block,
         )
         x = x + attn.reshape(S, H * hd) @ lp["wo"]
         h2 = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
         delta = (jax.nn.silu(h2 @ lp["w1"]) * (h2 @ lp["w3"])) @ lp["w2"]
-        return x + delta, (k_cache, v_cache)
+        return x + delta, (k_pool, v_pool)
 
     x, (new_k, new_v) = lax.scan(
         block, x, (params["layers"], cache.k, cache.v)
@@ -940,7 +1398,7 @@ def _decode_step(params, cache: BlockKVCache, state: _SlotState, *,
     lengths = cache.lengths + state.live.astype(jnp.int32)
     new_state = state._replace(last_tok=nxt, rng=both[:, 1], done=done)
     hmon = health.decode_monitors(logits) if monitors else {}
-    return BlockKVCache(new_k, new_v, lengths), new_state, nxt, hmon
+    return PagedKVCache(new_k, new_v, lengths), new_state, nxt, hmon
 
 
 

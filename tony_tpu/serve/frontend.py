@@ -12,6 +12,16 @@ semantics the gang exists for:
   on the frontend registry) — backpressure propagates to the caller, it
   is never buried in a queue. Host-side rejections (the engine's
   ``max_queue`` seam) reroute to another host.
+- **Prefix-affinity routing.** Requests sharing a prefix fingerprint
+  (the leading ``serve.prefix.fingerprint_tokens`` tokens) route to the
+  host whose prefix store already holds that prefix — the store is
+  per-host, so scattering same-template traffic across the gang would
+  re-prefill the prefix once per host instead of once per fleet. The
+  affinity host is only *preferred*: dead, draining, excluded-by-replay,
+  or clearly overloaded hosts fall back to least-loaded (and the
+  fingerprint re-pins to wherever the request lands). Replay-on-host-
+  death stays draw-for-draw identical — affinity changes WHERE a request
+  runs, never its rng stream or sampling.
 - **No request lost.** A decode host that dies mid-stream fails its
   relays with an RPC error; each such request is *re-queued* and
   *re-prefilled* on a survivor. Replay is draw-for-draw deterministic —
@@ -42,6 +52,7 @@ import logging
 import os
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Sequence
 
@@ -51,6 +62,7 @@ from tony_tpu.obs import series, trace
 from tony_tpu.obs.registry import Registry, write_snapshot
 from tony_tpu.rpc import ApplicationRpcClient, ServeRpcClient, pb
 from tony_tpu.serve.gang import GangSettings
+from tony_tpu.serve.prefix import fingerprint
 
 log = logging.getLogger(__name__)
 
@@ -136,10 +148,12 @@ class _Host:
 class _Flight:
     """One in-flight request's frontend state + its relay thread plumbing."""
 
-    def __init__(self, rid: str, req: "pb.InferenceRequest", span):
+    def __init__(self, rid: str, req: "pb.InferenceRequest", span,
+                 fp: int | None = None):
         self.rid = rid
         self.req = req
         self.span = span          # serve.request, open until completion
+        self.fp = fp              # prefix-affinity fingerprint (or None)
         self.submit_t = time.perf_counter()
         self.result = GangCompletion(rid=rid)
         self.done = threading.Event()
@@ -202,6 +216,9 @@ class GangFrontend:
         self._c_replays = self.registry.counter(
             "tony_serve_replays_total",
             "re-queued + re-prefilled requests after a host death")
+        self._c_affinity = self.registry.counter(
+            "tony_serve_affinity_routed_total",
+            "requests routed to their prefix-affinity host")
         self._g_hosts = self.registry.gauge(
             "tony_serve_gang_hosts", "routable decode hosts")
         self._g_inflight = self.registry.gauge(
@@ -215,6 +232,10 @@ class GangFrontend:
         )
         self._lease_store = lease_store
         self._app_id = app_id
+        # prefix-affinity map: fingerprint -> task_id of the host whose
+        # store holds that prefix (bounded LRU; guarded by _lock)
+        self._affinity: OrderedDict[int, str] = OrderedDict()
+        self._affinity_cap = 4096
         # the GangAsk one more decode host costs — the REAL container
         # resources (memory/cpus/tpu_chips of the gang's task type), or a
         # grow that leases a token ask would leave the new host's chips
@@ -246,6 +267,7 @@ class GangFrontend:
             "requests_total": float(self._c_submitted.value),
             "replays_total": float(self._c_replays.value),
             "rejected_total": float(self._c_rejected.value),
+            "affinity_routed_total": float(self._c_affinity.value),
         }
         d = self._ttft_window.delta(self._h_ttft)
         if d["count"]:
@@ -449,7 +471,10 @@ class GangFrontend:
         )
         plen = len(req.prompt)  # precomputed: disarmed span() must stay cheap
         span = trace.span("serve.request", rid=rid, prompt_len=plen)
-        flight = _Flight(rid, req, span)
+        fp = None
+        if self.settings.prefix_affinity and self.settings.prefix:
+            fp = fingerprint(req.prompt, self.settings.prefix_fingerprint_tokens)
+        flight = _Flight(rid, req, span, fp=fp)
         with self._lock:
             self._flights[rid] = flight
             self._done_events[rid] = flight.done
@@ -461,11 +486,14 @@ class GangFrontend:
         ).start()
         return rid
 
-    def _pick_host(self, exclude: set[str]) -> _Host | None:
-        """Least-loaded routable host (occupancy + queue depth via the
-        stats poll, plus locally assigned work); ``exclude`` skips hosts
-        this request already failed on — unless they are the only ones
-        left (a restarted task reuses its task_id)."""
+    def _pick_host(self, exclude: set[str], fp: int | None = None) -> _Host | None:
+        """Prefix-affinity host when ``fp`` names one that is routable and
+        not clearly overloaded, else least-loaded (occupancy + queue depth
+        via the stats poll, plus locally assigned work); ``exclude`` skips
+        hosts this request already failed on — unless they are the only
+        ones left (a restarted task reuses its task_id). The chosen host
+        becomes (or stays) the fingerprint's affinity — after a failover
+        the prefix re-pins to wherever the replay re-prefilled it."""
         with self._lock:
             alive = [
                 h for h in self._hosts.values()
@@ -475,6 +503,31 @@ class GangFrontend:
             if not preferred:
                 return None
             best = min(preferred, key=lambda h: h.load())
+            if fp is not None:
+                tid = self._affinity.get(fp)
+                if tid is not None:
+                    cand = next(
+                        (h for h in preferred if h.task_id == tid), None
+                    )
+                    # overload fallback: pinning is worthless if the
+                    # affinity host is saturated while another sits idle —
+                    # re-prefilling the prefix there is cheaper than
+                    # queueing behind a full host. A host whose stats poll
+                    # is failing (stale entry, wedged process) gets the
+                    # configured slot count as its estimate, so its
+                    # locally-assigned backlog still bounds the pile-up.
+                    if cand is not None:
+                        slots_est = (
+                            cand.stats.slots if cand.stats is not None
+                            else self.settings.slots
+                        )
+                        if cand.load() < 2 * max(slots_est, 1):
+                            best = cand
+                            self._c_affinity.inc()
+                self._affinity[fp] = best.task_id
+                self._affinity.move_to_end(fp)
+                while len(self._affinity) > self._affinity_cap:
+                    self._affinity.popitem(last=False)
             best.assigned += 1
             return best
 
@@ -505,7 +558,7 @@ class GangFrontend:
                     )
                     return
                 failed: set[str] = set(res.hosts)
-                host = self._pick_host(failed)
+                host = self._pick_host(failed, flight.fp)
                 if host is None:
                     stalled_since = stalled_since or time.monotonic()
                     time.sleep(self.NO_HOST_WAIT_S)

@@ -77,6 +77,12 @@ class GangSettings:
     autoscale_queue_high: int = 0
     autoscale_queue_low: int = 0
     autoscale_window_s: float = 10.0
+    # cross-request prefix reuse (serve/prefix.py) + the frontend's
+    # prefix-affinity routing over it (serve.prefix.* keys)
+    prefix: bool = True
+    prefix_budget_mb: float = 64.0
+    prefix_affinity: bool = True
+    prefix_fingerprint_tokens: int = 64
 
     @classmethod
     def from_config(cls, config: TonyConfig) -> "GangSettings":
@@ -103,6 +109,14 @@ class GangSettings:
             autoscale_queue_low=config.get_int(Keys.SERVE_GANG_AUTOSCALE_LOW, 0),
             autoscale_window_s=config.get_float(
                 Keys.SERVE_GANG_AUTOSCALE_WINDOW_S, 10.0
+            ),
+            prefix=config.get_bool(Keys.SERVE_PREFIX_ENABLED, True),
+            prefix_budget_mb=config.get_float(
+                Keys.SERVE_PREFIX_BUDGET_MB, 64.0
+            ),
+            prefix_affinity=config.get_bool(Keys.SERVE_PREFIX_AFFINITY, True),
+            prefix_fingerprint_tokens=config.get_int(
+                Keys.SERVE_PREFIX_FINGERPRINT_TOKENS, 64
             ),
         )
 
@@ -145,7 +159,8 @@ def build_gang_engine(settings: GangSettings) -> "Engine":
         params, cfg,
         ServeConfig(
             slots=settings.slots, max_len=settings.max_len,
-            max_queue=settings.max_queue,
+            max_queue=settings.max_queue, prefix=settings.prefix,
+            prefix_budget_mb=settings.prefix_budget_mb,
         ),
     )
 
