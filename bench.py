@@ -615,6 +615,65 @@ def decode_bench(on_tpu: bool) -> dict:
         trace_out["prefill_flops_ratio"] = round(tail_flops / full_flops, 4)
     out["prefix_trace"] = trace_out
 
+    # (e) speculative decoding (serve/spec.py): repeated greedy traffic,
+    # spec on vs off at batch 1 and batch `slots`. The first (warm) pass
+    # seeds the radix store with the prompt AND the generation, so the
+    # timed repeats draft along the observed path at near-full accept —
+    # the verify step emits several tokens per forward while each forward
+    # stays memory-bound. Headline: tokens/s/slot on/off speedup at b1
+    # (target >= 2x), tokens/step, accept rate, and the compile count
+    # (ONE extra signature family, never per-draft-length).
+    # prompt + generation block-aligned so the warm pass registers the
+    # WHOLE path as full radix blocks — the timed repeats then draft to
+    # the end of the generation, not just its full-block prefix
+    # gen length \equiv 1 (mod block): the LAST generated token's KV is
+    # never written (nothing decodes after it), so the path registered at
+    # finish is the first plen+gen-1 tokens — this choice makes that a
+    # whole number of blocks and the store covers the entire repeat
+    spec_new = max_new * 12 + 1
+    spec_draft = 15
+    spec_prompt = rng.integers(0, cfg.vocab_size, block)
+
+    def spec_mode(on: bool, batch: int) -> dict:
+        eng = Engine(params, cfg, ServeConfig(
+            slots=batch, max_len=max_len, kv_block=block,
+            spec=on, spec_max_draft=spec_draft,
+        ))
+        def reqs():
+            return [
+                Request(prompt=spec_prompt, max_new_tokens=spec_new, rng=i)
+                for i in range(batch)
+            ]
+        # warm TWICE: the first pass seeds the store (and pays the full-
+        # prefill compiles), the second pays the compiles only a repeat
+        # hits (tail prefill at the matched boundary, the spec step at
+        # its steady signatures) — the timed pass then measures serving,
+        # not XLA
+        eng.run(reqs())
+        eng.run(reqs())
+        eng.reset_metrics()
+        eng.run(reqs())
+        m = eng.metrics
+        r = {
+            "tok_s_slot": round(m.tokens_per_sec_per_chip / batch, 1),
+            "tokens_per_step": round(m.tokens_per_step, 3),
+            "decode_compiles": m.decode_compiles,
+        }
+        if on:
+            r["accept_rate"] = round(m.draft_accept_rate, 4)
+        return r
+
+    spec_out: dict = {"max_draft": spec_draft, "gen_tokens": spec_new}
+    for batch in (1, slots):
+        s_on, s_off = spec_mode(True, batch), spec_mode(False, batch)
+        spec_out[f"b{batch}_on"] = s_on
+        spec_out[f"b{batch}_off"] = s_off
+        if s_off["tok_s_slot"] > 0:
+            spec_out[f"speedup_b{batch}"] = round(
+                s_on["tok_s_slot"] / s_off["tok_s_slot"], 2
+            )
+    out["spec_trace"] = spec_out
+
     # native-GQA decode kernel vs the repeat-expanded reference (one
     # decode step of attention at full cache length, layer-scanned so
     # dispatch overhead amortises)

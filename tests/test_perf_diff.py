@@ -72,6 +72,22 @@ class TestFlattenAndRules:
             "extra.decode.prefix_trace.prefix_on.ttft_p99_s"
         )[0] == "lower"
         assert rule_for("decode_0.prefix_resident_mb")[0] == "skip"
+        # speculative decoding (serve/spec.py): tokens/step, accept rate
+        # and the on/off speedup are higher-better; rollbacks are
+        # trace-shaped; the draft depth is configuration; the compile
+        # count falls through to the zero-tolerance compile rule
+        assert rule_for(
+            "extra.decode.spec_trace.b1_on.tokens_per_step"
+        )[0] == "higher"
+        assert rule_for(
+            "extra.decode.spec_trace.b1_on.accept_rate"
+        )[0] == "higher"
+        assert rule_for("extra.decode.spec_trace.speedup_b1")[0] == "higher"
+        assert rule_for("decode_0.spec_rollbacks")[0] == "skip"
+        assert rule_for("extra.decode.spec_trace.max_draft")[0] == "config"
+        assert rule_for(
+            "extra.decode.spec_trace.b1_on.decode_compiles"
+        )[0] == "lower"
 
     def test_headroom_collapse_is_a_regression(self):
         v = diff(
@@ -113,6 +129,11 @@ class TestVerdict:
         assert "extra.decode.prefix_trace.prefix_on.prefix_hit_rate" in keys
         assert "extra.decode.prefix_trace.ttft_p50_ratio" in keys
         assert "extra.decode.prefix_trace.prefill_flops_ratio" in keys
+        # the speculative-decoding section gates too: an accept-rate
+        # collapse drags tokens/step and the on/off speedup with it
+        assert "extra.decode.spec_trace.b1_on.accept_rate" in keys
+        assert "extra.decode.spec_trace.b1_on.tokens_per_step" in keys
+        assert "extra.decode.spec_trace.speedup_b1" in keys
         # within-tolerance drift is NOT flagged
         assert "extra.loss" not in keys          # +0.04% << 2%
         assert "extra.peak_hbm_gb" not in keys   # +1.5% << 10%

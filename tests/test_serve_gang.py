@@ -507,6 +507,9 @@ def test_gang_serve_e2e_kill_container_midstream(tmp_path):
         "serve.gang.max_len": 256,
         "serve.gang.max_queue": 8,
         "serve.gang.ttft_budget_s": 120,
+        # speculation stays on through the kill: accepted multi-token
+        # steps must not break replay determinism or the ledger
+        "serve.spec.enabled": True,
         "job.decode.instances": 2,
         "job.decode.command": f"{sys.executable} -m tony_tpu.serve.gang",
         "job.decode.env": ["JAX_PLATFORMS=cpu"],

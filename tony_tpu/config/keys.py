@@ -217,6 +217,19 @@ class Keys:
     # than this route purely by load (too little prefix to pin a host for)
     SERVE_PREFIX_FINGERPRINT_TOKENS = "serve.prefix.fingerprint_tokens"
 
+    # --- speculative decoding (model-free drafts; serve/spec.py) ---
+    # trie/n-gram drafted multi-token decode steps: each slot proposes up
+    # to max_draft tokens per step, the engine verifies all of them in
+    # ONE widened forward and accepts via the exact rejection rule —
+    # output stays draw-for-draw identical to autoregressive decoding
+    SERVE_SPEC_ENABLED = "serve.spec.enabled"
+    # draft tokens proposed per slot per step (the verify step scores
+    # max_draft + 1 positions; one decode signature per engine)
+    SERVE_SPEC_MAX_DRAFT = "serve.spec.max_draft"
+    # draft source: auto (radix store first, n-gram fallback) | prefix
+    # (store only) | ngram (the slot's own prompt-lookup only)
+    SERVE_SPEC_DRAFT_SOURCE = "serve.spec.draft_source"
+
     # --- cluster backend ---
     # Deliberate non-goals vs the reference key surface: docker keys (no
     # container runtime in this environment — processes are the container
@@ -372,6 +385,9 @@ DEFAULTS: dict[str, object] = {
     Keys.SERVE_PREFIX_BUDGET_MB: 64,
     Keys.SERVE_PREFIX_AFFINITY: True,
     Keys.SERVE_PREFIX_FINGERPRINT_TOKENS: 64,
+    Keys.SERVE_SPEC_ENABLED: False,
+    Keys.SERVE_SPEC_MAX_DRAFT: 4,
+    Keys.SERVE_SPEC_DRAFT_SOURCE: "auto",
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",

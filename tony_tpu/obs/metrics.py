@@ -104,10 +104,21 @@ class DecodeMetrics:
     prompt_tokens: int = 0         # prompt tokens admitted
     prefix_hit_tokens: int = 0     # prompt tokens served from the prefix
     #                                store (no re-prefill; serve/prefix.py)
+    decode_tokens: int = 0         # tokens emitted by decode steps only
+    decode_live_sum: int = 0       # sum over decode steps of live slots
+    draft_proposed: int = 0        # speculative draft tokens proposed
+    draft_accepted: int = 0        # ... of which the target accepted
+    spec_rollbacks: int = 0        # ... of which were rejected (discarded)
 
     def record_prompt(self, plen: int, hit_tokens: int = 0) -> None:
         self.prompt_tokens += plen
         self.prefix_hit_tokens += hit_tokens
+
+    def record_spec(self, proposed: int, accepted: int) -> None:
+        """One speculative step's draft accounting (serve/spec.py)."""
+        self.draft_proposed += proposed
+        self.draft_accepted += accepted
+        self.spec_rollbacks += proposed - accepted
 
     def record_prefill(self, dt_s: float, ttft_s: float) -> None:
         self.prefill_s += dt_s
@@ -121,6 +132,8 @@ class DecodeMetrics:
         self.decode_s += dt_s
         self.decode_steps += 1
         self.generated_tokens += new_tokens
+        self.decode_tokens += new_tokens
+        self.decode_live_sum += live
         self.occupancy_sum += live / max(slots, 1)
 
     @property
@@ -155,6 +168,21 @@ class DecodeMetrics:
             return 0.0
         return self.prefix_hit_tokens / self.prompt_tokens
 
+    @property
+    def tokens_per_step(self) -> float:
+        """Decode tokens per step per LIVE slot — exactly 1.0
+        autoregressively at any batch size, up to ``spec_max_draft + 1``
+        with speculative decoding accepting (serve/spec.py)."""
+        if self.decode_live_sum == 0:
+            return 0.0
+        return self.decode_tokens / self.decode_live_sum
+
+    @property
+    def draft_accept_rate(self) -> float:
+        if self.draft_proposed == 0:
+            return 0.0
+        return self.draft_accepted / self.draft_proposed
+
     def summary(self) -> dict:
         out = {
             "tokens_per_sec_per_chip": round(self.tokens_per_sec_per_chip, 1),
@@ -167,7 +195,12 @@ class DecodeMetrics:
             "prefill_compiles": self.prefill_compiles,
             "decode_compiles": self.decode_compiles,
         }
+        if self.decode_steps:
+            out["tokens_per_step"] = round(self.tokens_per_step, 3)
         if self.prompt_tokens:
             out["prefix_hit_tokens"] = self.prefix_hit_tokens
             out["prefix_hit_rate"] = round(self.prefix_hit_rate, 4)
+        if self.draft_proposed:
+            out["draft_accept_rate"] = round(self.draft_accept_rate, 4)
+            out["spec_rollbacks"] = self.spec_rollbacks
         return out

@@ -84,6 +84,14 @@ DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
     (r"prefix_(hit_rate|hit_tokens)", "higher", 0.05),
     (r"prefix.*(ttft|flops).*ratio", "lower", 0.10),
     (r"prefix_(resident|evicted|nodes)", "skip", 0.0),
+    # speculative decoding (serve/spec.py, bench `decode.spec_trace`):
+    # tokens emitted per decode step, the draft accept rate, and the
+    # spec-on/off speedup are the headline — higher is better; rollback
+    # counts are trace-shaped (they scale with how much was proposed),
+    # skip them. Compile counts fall through to the compile rule below.
+    (r"(max_draft|gen_tokens)", "config", 0.0),
+    (r"(tokens_per_step|accept_rate|speedup)", "higher", 0.05),
+    (r"(spec_rollbacks|draft_proposed|draft_accepted)", "skip", 0.0),
     # memory: lower is better, generous tolerance (allocator noise)
     (r"(hbm|bytes|_gb$|_mb$|rss)", "lower", 0.10),
     # compile counts: lower is better (a silent recompile regression)

@@ -37,6 +37,7 @@ _VALUE_COLS = (
     ("queue", "queue_depth", "{:.0f}"),
     ("occup", "occupancy", "{:.2f}"),
     ("hit%", "prefix_hit_rate", "{:.2f}"),  # prefix-store reuse (serve)
+    ("tok/st", "tokens_per_step", "{:.2f}"),  # >1 = speculation paying off
     ("goodput", "goodput_frac", "{:.2f}"),
     ("hbm_gb", "hbm_live_bytes", None),  # formatted specially
 )

@@ -72,7 +72,24 @@ def reference_decode_attention(
 
     q: [B, H, hd]; k/v: [B, Hkv, T, hd]; lengths: [B] int32 (positions
     < lengths[b] are attended). Returns [B, H, hd].
+
+    Speculative form: q ``[B, G, H, hd]`` carries G query positions per
+    row (the last real token plus G-1 draft tokens, serve/spec.py) and
+    ``lengths`` counts the cache AFTER all G writes — query g of row b
+    attends positions ``< lengths[b] - (G - 1) + g``, so G=1 reduces
+    exactly to the one-token rule. Returns [B, G, H, hd].
     """
+    if q.ndim == 4:
+        G = q.shape[1]
+        return jnp.stack(
+            [
+                reference_decode_attention(
+                    q[:, g], k, v, lengths - (G - 1) + g, scale=scale
+                )
+                for g in range(G)
+            ],
+            axis=1,
+        )
     B, H, hd = q.shape
     Hkv, T = k.shape[1], k.shape[2]
     rep = H // Hkv
@@ -92,34 +109,39 @@ def reference_decode_attention(
 
 
 def _decode_scan(q, k, v, lengths, *, scale, block):
-    """Online-softmax scan over KV blocks, native GQA contraction."""
-    B, H, hd = q.shape
+    """Online-softmax scan over KV blocks, native GQA contraction.
+    q ``[B, G, H, hd]``: query g attends ``< lengths[b] - (G-1) + g``."""
+    B, G, H, hd = q.shape
     Hkv, T = k.shape[1], k.shape[2]
     rep = H // Hkv
     nb = T // block
-    qg = q.reshape(B, Hkv, rep, hd)
+    qg = q.reshape(B, G, Hkv, rep, hd)
+    goff = jnp.arange(G, dtype=jnp.int32)
 
-    m0 = jnp.full((B, Hkv, rep), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, Hkv, rep), jnp.float32)
-    acc0 = jnp.zeros((B, Hkv, rep, hd), jnp.float32)
+    m0 = jnp.full((B, G, Hkv, rep), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, G, Hkv, rep), jnp.float32)
+    acc0 = jnp.zeros((B, G, Hkv, rep, hd), jnp.float32)
 
     def body(carry, j):
         m, l, acc = carry
         kb = lax.dynamic_slice_in_dim(k, j * block, block, axis=2)
         vb = lax.dynamic_slice_in_dim(v, j * block, block, axis=2)
         s = jnp.einsum(
-            "bgrd,bgkd->bgrk", qg, kb, preferred_element_type=jnp.float32
+            "bgxrd,bxkd->bgxrk", qg, kb, preferred_element_type=jnp.float32
         ) * scale
         pos = j * block + jnp.arange(block)
-        valid = pos[None, :] < lengths[:, None]                # [B, block]
-        s = jnp.where(valid[:, None, None, :], s, _NEG)
+        valid = pos[None, None, :] < (
+            lengths[:, None, None] - (G - 1) + goff[None, :, None]
+        )                                                      # [B, G, block]
+        vmask = valid[:, :, None, None, :]
+        s = jnp.where(vmask, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
-        p = jnp.where(valid[:, None, None, :], p, 0.0)
+        p = jnp.where(vmask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
-            "bgrk,bgkd->bgrd", p.astype(vb.dtype), vb,
+            "bgxrk,bxkd->bgxrd", p.astype(vb.dtype), vb,
             preferred_element_type=jnp.float32,
         )
         return (m_new, l, acc), None
@@ -128,21 +150,22 @@ def _decode_scan(q, k, v, lengths, *, scale, block):
         body, (m0, l0, acc0), jnp.arange(nb, dtype=jnp.int32)
     )
     out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return out.reshape(B, H, hd).astype(q.dtype)
+    return out.reshape(B, G, H, hd).astype(q.dtype)
 
 
 def _paged_scan(q, k, v, lengths, tables, *, scale):
     """Online-softmax scan over *logical* blocks, each row's block gathered
     through its table entry (native GQA contraction, paged pools)."""
-    B, H, hd = q.shape
+    B, G, H, hd = q.shape
     Hkv, blk = k.shape[1], k.shape[2]
     rep = H // Hkv
     nb = tables.shape[1]
-    qg = q.reshape(B, Hkv, rep, hd)
+    qg = q.reshape(B, G, Hkv, rep, hd)
+    goff = jnp.arange(G, dtype=jnp.int32)
 
-    m0 = jnp.full((B, Hkv, rep), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, Hkv, rep), jnp.float32)
-    acc0 = jnp.zeros((B, Hkv, rep, hd), jnp.float32)
+    m0 = jnp.full((B, G, Hkv, rep), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, G, Hkv, rep), jnp.float32)
+    acc0 = jnp.zeros((B, G, Hkv, rep, hd), jnp.float32)
 
     def body(carry, j):
         m, l, acc = carry
@@ -150,18 +173,21 @@ def _paged_scan(q, k, v, lengths, tables, *, scale):
         kb = jnp.take(k, pid, axis=0)                          # [B, Hkv, blk, hd]
         vb = jnp.take(v, pid, axis=0)
         s = jnp.einsum(
-            "bgrd,bgkd->bgrk", qg, kb, preferred_element_type=jnp.float32
+            "bgxrd,bxkd->bgxrk", qg, kb, preferred_element_type=jnp.float32
         ) * scale
         pos = j * blk + jnp.arange(blk)
-        valid = pos[None, :] < lengths[:, None]                # [B, blk]
-        s = jnp.where(valid[:, None, None, :], s, _NEG)
+        valid = pos[None, None, :] < (
+            lengths[:, None, None] - (G - 1) + goff[None, :, None]
+        )                                                      # [B, G, blk]
+        vmask = valid[:, :, None, None, :]
+        s = jnp.where(vmask, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
-        p = jnp.where(valid[:, None, None, :], p, 0.0)
+        p = jnp.where(vmask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
-            "bgrk,bgkd->bgrd", p.astype(vb.dtype), vb,
+            "bgxrk,bxkd->bgxrd", p.astype(vb.dtype), vb,
             preferred_element_type=jnp.float32,
         )
         return (m_new, l, acc), None
@@ -170,14 +196,14 @@ def _paged_scan(q, k, v, lengths, tables, *, scale):
         body, (m0, l0, acc0), jnp.arange(nb, dtype=jnp.int32)
     )
     out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return out.reshape(B, H, hd).astype(q.dtype)
+    return out.reshape(B, G, H, hd).astype(q.dtype)
 
 
 # --- pallas (TPU) implementation ----------------------------------------------
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc, l_sc,
-                   *, scale, block, kv_heads):
+                   *, scale, block, kv_heads, rep, queries):
     b, j = pl.program_id(0), pl.program_id(1)
     nb = pl.num_programs(1)
     row_len = len_ref[b // kv_heads]
@@ -195,9 +221,13 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc, l_sc,
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                              # [rep, block]
+        ) * scale                                      # [queries*rep, block]
         pos = j * block + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = pos < row_len
+        # folded row r is query g = r // rep: speculative query g may only
+        # see positions < row_len - (G-1) + g (row_len counts the cache
+        # AFTER all G writes; G=1 reduces to the plain < row_len rule)
+        gq = lax.broadcasted_iota(jnp.int32, s.shape, 0) // rep
+        valid = pos < row_len - (queries - 1) + gq
         s = jnp.where(valid, s, _NEG)
         m_prev = m_sc[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -217,44 +247,53 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc, l_sc,
 
 
 def _decode_pallas(q, k, v, lengths, *, scale, block):
-    B, H, hd = q.shape
+    B, G, H, hd = q.shape
     Hkv, T = k.shape[1], k.shape[2]
     rep = H // Hkv
     nb = T // block
-    qf = q.reshape(B * Hkv, rep, hd)
+    R = G * rep
+    # fold the G query positions into the tile rows: grid row b*Hkv + x
+    # computes every (g, r) pair of row b's kv-head x at once, so the
+    # speculative widening adds zero grid steps and zero extra K/V DMA
+    qf = q.reshape(B, G, Hkv, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
+        B * Hkv, R, hd
+    )
     kf = k.reshape(B * Hkv, T, hd)
     vf = v.reshape(B * Hkv, T, hd)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B * Hkv, nb),
         in_specs=[
-            pl.BlockSpec((1, rep, hd), lambda b, j, ln: (b, 0, 0)),
+            pl.BlockSpec((1, R, hd), lambda b, j, ln: (b, 0, 0)),
             pl.BlockSpec((1, block, hd), lambda b, j, ln: (b, j, 0)),
             pl.BlockSpec((1, block, hd), lambda b, j, ln: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, rep, hd), lambda b, j, ln: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, R, hd), lambda b, j, ln: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((rep, hd), jnp.float32),
-            pltpu.VMEM((rep, 1), jnp.float32),
-            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((R, hd), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, scale=scale, block=block, kv_heads=Hkv
+            _decode_kernel, scale=scale, block=block, kv_heads=Hkv,
+            rep=rep, queries=G,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B * Hkv, rep, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, R, hd), q.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=_use_interpret(),
     )(lengths.astype(jnp.int32), qf, kf, vf)
-    return out.reshape(B, H, hd)
+    return out.reshape(B, Hkv, G, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
+        B, G, H, hd
+    )
 
 
 def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc,
-                  l_sc, *, scale, block, kv_heads):
+                  l_sc, *, scale, block, kv_heads, rep, queries):
     i, j = pl.program_id(0), pl.program_id(1)
     nb = pl.num_programs(1)
     row_len = len_ref[i // kv_heads]
@@ -270,9 +309,12 @@ def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc,
         q, k, v = q_ref[0], k_ref[0, 0], v_ref[0, 0]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale                                              # [rep, block]
+        ) * scale                                      # [queries*rep, block]
         pos = j * block + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = pos < row_len
+        # same affine speculative mask as _decode_kernel: row r is query
+        # g = r // rep, attending < row_len - (G-1) + g
+        gq = lax.broadcasted_iota(jnp.int32, s.shape, 0) // rep
+        valid = pos < row_len - (queries - 1) + gq
         s = jnp.where(valid, s, _NEG)
         m_prev = m_sc[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -295,16 +337,19 @@ def _paged_pallas(q, k, v, lengths, tables, *, scale):
     """Grid (B * Hkv, M): the table rides as scalar prefetch and its values
     steer the K/V BlockSpec index map, so each tile's DMA fetches the
     physical block the row's table names (no gather materialised)."""
-    B, H, hd = q.shape
+    B, G, H, hd = q.shape
     Hkv, blk = k.shape[1], k.shape[2]
     rep = H // Hkv
     nb = tables.shape[1]
-    qf = q.reshape(B * Hkv, rep, hd)
+    R = G * rep
+    qf = q.reshape(B, G, Hkv, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
+        B * Hkv, R, hd
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B * Hkv, nb),
         in_specs=[
-            pl.BlockSpec((1, rep, hd), lambda i, j, ln, tb: (i, 0, 0)),
+            pl.BlockSpec((1, R, hd), lambda i, j, ln, tb: (i, 0, 0)),
             pl.BlockSpec(
                 (1, 1, blk, hd),
                 lambda i, j, ln, tb, kv_heads=Hkv: (
@@ -318,25 +363,28 @@ def _paged_pallas(q, k, v, lengths, tables, *, scale):
                 ),
             ),
         ],
-        out_specs=pl.BlockSpec((1, rep, hd), lambda i, j, ln, tb: (i, 0, 0)),
+        out_specs=pl.BlockSpec((1, R, hd), lambda i, j, ln, tb: (i, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((rep, hd), jnp.float32),
-            pltpu.VMEM((rep, 1), jnp.float32),
-            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((R, hd), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(
-            _paged_kernel, scale=scale, block=blk, kv_heads=Hkv
+            _paged_kernel, scale=scale, block=blk, kv_heads=Hkv,
+            rep=rep, queries=G,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B * Hkv, rep, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * Hkv, R, hd), q.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=_use_interpret(),
     )(lengths.astype(jnp.int32), tables.astype(jnp.int32), qf, k, v)
-    return out.reshape(B, H, hd)
+    return out.reshape(B, Hkv, G, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
+        B, G, H, hd
+    )
 
 
 # --- public entry -------------------------------------------------------------
@@ -365,8 +413,19 @@ def decode_attention(
     ``tables[b, j]`` — the serve engine's copy-on-write sharing substrate
     (serve/cache.py, serve/prefix.py). Entries beyond a row's length must
     still be valid pool ids (the engine points them at the scratch block).
+
+    Speculative form: q ``[B, G, H, head_dim]`` verifies G query positions
+    per row in one call (serve/spec.py) — ``lengths`` counts the cache
+    AFTER all G writes, and query g of row b attends positions
+    ``< lengths[b] - (G - 1) + g`` (for G=1 exactly the one-token rule).
+    Returns [B, G, H, head_dim]. Works in both contiguous and paged form;
+    both impls fold the G positions into the existing tile rows, so the
+    per-step K/V traffic does not grow with G.
     """
-    B, H, hd = q.shape
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    B, G, H, hd = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(hd)
     if impl not in ("scan", "pallas"):
@@ -386,8 +445,10 @@ def decode_attention(
                 f"n_heads {H} not a multiple of n_kv_heads {k.shape[1]}"
             )
         if impl == "pallas":
-            return _paged_pallas(q, k, v, lengths, tables, scale=scale)
-        return _paged_scan(q, k, v, lengths, tables, scale=scale)
+            out = _paged_pallas(q, k, v, lengths, tables, scale=scale)
+        else:
+            out = _paged_scan(q, k, v, lengths, tables, scale=scale)
+        return out[:, 0] if squeeze else out
     if k.shape != v.shape or k.shape[0] != B or k.shape[3] != hd:
         raise ValueError(f"decode_attention shapes q={q.shape} k={k.shape} v={v.shape}")
     Hkv, T = k.shape[1], k.shape[2]
@@ -397,8 +458,10 @@ def decode_attention(
     if T % blk:
         raise ValueError(f"cache length {T} must be a multiple of block {blk}")
     if impl == "pallas":
-        return _decode_pallas(q, k, v, lengths, scale=scale, block=blk)
-    return _decode_scan(q, k, v, lengths, scale=scale, block=blk)
+        out = _decode_pallas(q, k, v, lengths, scale=scale, block=blk)
+    else:
+        out = _decode_scan(q, k, v, lengths, scale=scale, block=blk)
+    return out[:, 0] if squeeze else out
 
 
 __all__ = ["decode_attention", "reference_decode_attention"]

@@ -110,6 +110,23 @@ def blocks_for(length: int, block: int) -> int:
     return max(1, math.ceil(length / block))
 
 
+def scatter_block_kv(pool: jax.Array, new: jax.Array, pids: jax.Array,
+                     offs: jax.Array) -> jax.Array:
+    """Paged KV write into ONE layer's ``[P, Hkv, block, hd]`` pool.
+
+    ``pids``/``offs`` name each new entry's physical block and in-block
+    offset. With 1-D ``[S]`` indices ``new`` is ``[S, Hkv, hd]`` (the
+    classic one-token decode step); with 2-D ``[S, G]`` indices it is
+    ``[S, G, Hkv, hd]`` — the speculative multi-position write
+    (serve/spec.py): row s's G draft positions land in one scatter.
+    Entries that must not land anywhere real (dead slots, padding beyond
+    a row's draft length) are the CALLER's job to steer to
+    ``SCRATCH_BLOCK``. The advanced indices (``pids`` on axis 0, ``offs``
+    on axis 2) are non-adjacent, so the indexed result moves the index
+    dims to the front — exactly ``new``'s layout, no transpose needed."""
+    return pool.at[pids, :, offs, :].set(new)
+
+
 def block_bytes(cfg, block: int, dtype=None) -> int:
     """HBM bytes one physical block costs (K + V across all layers)."""
     dt = jnp.dtype(dtype or cfg.dtype)
@@ -216,5 +233,6 @@ __all__ = [
     "blocks_for",
     "create_cache",
     "grow_cache",
+    "scatter_block_kv",
     "shrink_cache",
 ]

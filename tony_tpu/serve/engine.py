@@ -69,9 +69,12 @@ from tony_tpu.obs.registry import HistogramWindow, Registry, snapshot_to_app_dir
 from tony_tpu.ops.decode_attention import decode_attention
 from tony_tpu.serve.cache import (
     SCRATCH_BLOCK, BlockPool, PagedKVCache, block_bytes, blocks_for,
-    create_cache, grow_cache, shrink_cache,
+    create_cache, grow_cache, scatter_block_kv, shrink_cache,
 )
 from tony_tpu.serve.prefix import MatchResult, PrefixStore
+from tony_tpu.serve.spec import (
+    DRAFT_SOURCES, propose_drafts, verify_and_accept,
+)
 
 log = logging.getLogger(__name__)
 
@@ -117,6 +120,19 @@ class ServeConfig:
     # leaves evict beyond it (serve.prefix.budget_mb). 0 = bound only by
     # allocation pressure (the pool cap).
     prefix_budget_mb: float = 64.0
+    # speculative decoding (serve/spec.py): each slot drafts up to
+    # spec_max_draft tokens per step (radix-store longest extension, or
+    # n-gram prompt-lookup over its own context) and ONE widened decode
+    # step verifies them all — accepted drafts multiply tokens/step with
+    # draw-for-draw identical output (docs/SERVE.md "Speculative
+    # decoding"). With spec on, finished requests also register their
+    # generated tokens' blocks into the prefix store (the draft corpus).
+    spec: bool = False
+    # draft tokens per slot per step (k; the verify step scores k+1
+    # positions). One extra decode signature per (k, pool, attended).
+    spec_max_draft: int = 4
+    # 'auto' (store first, n-gram fallback) | 'prefix' | 'ngram'
+    spec_draft_source: str = "auto"
 
 
 class AdmissionRejected(RuntimeError):
@@ -235,12 +251,21 @@ class Engine:
                 f"prefill bucket {buckets[-1]} exceeds the cache capacity "
                 f"ceiling {cap} (max_len {max_len} rounded up to kv_block)"
             )
+        if serve.spec_draft_source not in DRAFT_SOURCES:
+            raise ValueError(
+                f"spec_draft_source {serve.spec_draft_source!r} not in "
+                f"{DRAFT_SOURCES}"
+            )
+        if serve.spec and serve.spec_max_draft < 1:
+            raise ValueError("spec_max_draft must be >= 1 with spec on")
         self.serve = ServeConfig(
             slots=serve.slots, max_len=max_len, kv_block=serve.kv_block,
             prefill_buckets=buckets, decode_impl=serve.decode_impl,
             max_top_k=serve.max_top_k, shrink=serve.shrink,
             max_queue=serve.max_queue, prefix=serve.prefix,
-            prefix_budget_mb=serve.prefix_budget_mb,
+            prefix_budget_mb=serve.prefix_budget_mb, spec=serve.spec,
+            spec_max_draft=serve.spec_max_draft,
+            spec_draft_source=serve.spec_draft_source,
         )
         S = self.serve.slots
         try:
@@ -303,6 +328,14 @@ class Engine:
         self._prefill_fns: dict[int, Any] = {}
         self._tail_fns: dict[tuple[int, int], Any] = {}
         self._decode_fns: dict[tuple[int, int], Any] = {}
+        # speculative verify steps, same (pool, attended) signature ladder
+        # at the engine's fixed draft width k (one extra signature per
+        # ladder rung — the bounded-compile contract carries over)
+        self._spec_fns: dict[tuple[int, int], Any] = {}
+        # host token context per slot (prompt + every emitted token, the
+        # next input token last) — the draft sources read it; maintained
+        # only with spec on
+        self._slot_ctx: list[list[int]] = [[] for _ in range(S)]
         # trace/metrics spine: join the job's trace from the AM-exported
         # env (no-op outside a traced tony-tpu job, idempotent when the
         # user script armed it already), then per-request span handles
@@ -447,7 +480,15 @@ class Engine:
             "generated_tokens": float(self._c_tokens.value),
             "requests_finished": float(self._c_finished.value),
             "rejected_total": float(self._c_rejected.value),
+            # decode tokens emitted per decode step: 1.0 autoregressive,
+            # > 1 when speculative drafts land (`tony top`'s tok/st)
+            "tokens_per_step": round(self.metrics.tokens_per_step, 4),
         }
+        if self.serve.spec:
+            snap["draft_accept_rate"] = round(
+                self.metrics.draft_accept_rate, 4
+            )
+            snap["spec_rollbacks"] = float(self.metrics.spec_rollbacks)
         if self._store is not None:
             # cross-request reuse health (cumulative): hit rate feeds the
             # series recorder, the portal, and `tony top`'s hit% column
@@ -514,6 +555,14 @@ class Engine:
         self._g_prefix_nodes = reg.gauge(
             "tony_serve_prefix_nodes", "radix nodes resident in the store",
         )
+        self._c_draft_prop = reg.counter(
+            "tony_serve_draft_proposed_total",
+            "speculative draft tokens proposed (serve/spec.py)",
+        )
+        self._c_draft_acc = reg.counter(
+            "tony_serve_draft_accepted_total",
+            "speculative draft tokens accepted (target sample agreed)",
+        )
 
     def reset_metrics(self) -> None:
         """Fresh throughput/latency counters (e.g. after a warmup trace
@@ -524,7 +573,7 @@ class Engine:
         self.metrics = DecodeMetrics(
             n_chips=self.metrics.n_chips,
             prefill_compiles=len(self._prefill_fns) + len(self._tail_fns),
-            decode_compiles=len(self._decode_fns),
+            decode_compiles=len(self._decode_fns) + len(self._spec_fns),
         )
         self._init_registry()
         # windowed-snapshot baselines re-base with the counters: a stale
@@ -739,6 +788,10 @@ class Engine:
             self._decode_spans[rid] = tracer.span("serve.decode", rid=rid, slot=slot)
 
         self._slot_len[slot] = plen
+        if self.serve.spec:
+            # draft context: prompt + every emitted token (input token
+            # last) — what the host-side draft sources extend
+            self._slot_ctx[slot] = [int(t) for t in prompt] + [tok]
         st = self.state
         eos = -1 if req.eos_id is None else int(req.eos_id)
         self.state = _SlotState(
@@ -768,6 +821,24 @@ class Engine:
         rid = self._slot_rid[slot]
         comp = self._completions[rid]
         comp.finish_reason = reason
+        if self._store is not None and self.serve.spec:
+            # draft corpus: register the GENERATED tokens' full blocks
+            # too (prompt blocks landed at admission), so future drafts
+            # extend along observed generations — the radix tree caching
+            # generated sequences, SGLang-style. The K/V'd sequence is
+            # the context minus its last token (sampled, never fed).
+            B = self.serve.kv_block
+            seq = self._slot_ctx[slot][:self._slot_len[slot]]
+            n_full = len(seq) // B
+            if n_full:
+                self._store.insert(
+                    seq[:n_full * B],
+                    self._table[slot, :n_full].tolist(), self._pool.retain,
+                )
+                self._store.evict_to_budget(self._pool.release)
+                self._g_prefix_bytes.set(self._store.resident_bytes)
+                self._g_prefix_nodes.set(self._store.n_nodes)
+        self._slot_ctx[slot] = []
         self.metrics.requests_finished += 1
         self._c_finished.inc()
         t_first = self._first_tok_t.pop(rid, None)
@@ -1026,25 +1097,74 @@ class Engine:
                 self._table_dev, self.state, self._ledger,
                 monitors=self._monitors,
             )
-            self.metrics.decode_compiles = len(self._decode_fns)
+            self.metrics.decode_compiles = (
+                len(self._decode_fns) + len(self._spec_fns)
+            )
         return self._decode_fns[signature]
+
+    def _get_spec_decode(self, signature: tuple[int, int]):
+        """The speculative (G = spec_max_draft + 1)-position verify step.
+        Same signature space as the 1-wide step — (pool blocks, attended
+        table width) — at ONE fixed G per engine, so spec adds at most a
+        bounded mirror of the plain ledger, never a per-draft-length
+        signature family (short drafts pad to G with writes steered to
+        the scratch block)."""
+        if signature not in self._spec_fns:
+            self._spec_fns[signature] = _aot_spec_decode(
+                self.cfg, self.serve.decode_impl, self.serve.kv_block,
+                self.serve.max_top_k, self.serve.spec_max_draft,
+                self.params, self.cache, self._table_dev, self.state,
+                self._ledger, monitors=self._monitors,
+            )
+            self.metrics.decode_compiles = (
+                len(self._decode_fns) + len(self._spec_fns)
+            )
+        return self._spec_fns[signature]
 
     # --- decode loop ----------------------------------------------------------
 
+    def _propose_step_drafts(
+        self, live: list[int]
+    ) -> tuple[np.ndarray | None, list[int]]:
+        """Host-side draft pass (spec on): ask the draft sources for up to
+        k tokens per live slot, capped so the emitted count can never
+        overrun the slot's token budget (``remaining - 1``: the bonus
+        token always emits). Pure python — GL001-clean."""
+        k_max = self.serve.spec_max_draft if self.serve.spec else 0
+        dlens = [0] * self.serve.slots
+        if not k_max:
+            return None, dlens
+        drafts = np.zeros((self.serve.slots, k_max), np.int32)
+        for s in live:
+            cap = min(k_max, self._slot_remaining[s] - 1)
+            if cap <= 0:
+                continue
+            prop = propose_drafts(
+                self._slot_ctx[s], self._store, cap,
+                self.serve.spec_draft_source,
+            )
+            if prop:
+                dlens[s] = len(prop)
+                drafts[s, :len(prop)] = prop
+        return drafts, dlens
+
     def _decode_once(self) -> None:
-        # per-step block planning: a live row whose write position starts
-        # a new block gets one allocated NOW (host-side, before dispatch);
-        # the attended table width tracks the live maximum
+        # per-step block planning: a live row allocates blocks NOW to
+        # cover every position this step may write (host-side, before
+        # dispatch) — position pos autoregressively, pos..pos+draft_len
+        # speculatively; the attended table width tracks the live maximum
         B = self.serve.kv_block
         live_before = [s for s, r in enumerate(self._slot_rid) if r is not None]
+        drafts_np, dlens = self._propose_step_drafts(live_before)
+        spec_step = any(dlens)
         need = 1
         for s in live_before:
-            pos = self._slot_len[s]
-            if pos % B == 0 and self._slot_blocks[s] == pos // B:
-                self._table[s, pos // B] = self._alloc_block()
+            last = self._slot_len[s] + (dlens[s] if spec_step else 0)
+            while self._slot_blocks[s] * B <= last:
+                self._table[s, self._slot_blocks[s]] = self._alloc_block()
                 self._slot_blocks[s] += 1
                 self._table_dirty = True
-            need = max(need, pos // B + 1)
+            need = max(need, last // B + 1)
         self._set_attended(need)
         tracer = trace.active_tracer()
         sp = trace.NOOP_SPAN
@@ -1052,17 +1172,39 @@ class Engine:
             sp = tracer.sampled_span("serve.step", live=len(live_before))
         with sp:
             t0 = time.perf_counter()
-            self.cache, self.state, toks, hmon = self._get_decode(
-                (self.cache.n_blocks, self._attended)
-            )(self.params, self.cache, self._table_dev, self.state)
+            sig = (self.cache.n_blocks, self._attended)
+            if spec_step:
+                self.cache, self.state, toks, n_emit, hmon = \
+                    self._get_spec_decode(sig)(
+                        self.params, self.cache, self._table_dev, self.state,
+                        jnp.asarray(drafts_np),
+                        jnp.asarray(np.asarray(dlens, np.int32)),
+                    )
+            else:
+                # no live slot drafted: the plain 1-wide step (also the
+                # only step compiled with spec off — same signatures as
+                # the pre-spec engine)
+                self.cache, self.state, toks, hmon = self._get_decode(sig)(
+                    self.params, self.cache, self._table_dev, self.state
+                )
             # EXPLICIT per-step sync: continuous batching needs the sampled
             # tokens + done flags on host to steer admission — this is the
             # engine's one designed sync point per decode step
-            toks_np = jax.device_get(toks)
+            toks_np = np.asarray(jax.device_get(toks))
+            emit_np = np.asarray(jax.device_get(n_emit)) if spec_step else None
             done_np = jax.device_get(self.state.done)
             dt = time.perf_counter() - t0
+        if spec_step:
+            new_total = int(sum(int(emit_np[s]) for s in live_before))
+            prop_total = sum(dlens[s] for s in live_before)
+            acc_total = sum(max(int(emit_np[s]) - 1, 0) for s in live_before)
+            self.metrics.record_spec(prop_total, acc_total)
+            self._c_draft_prop.inc(prop_total)
+            self._c_draft_acc.inc(acc_total)
+        else:
+            new_total = len(live_before)
         self.metrics.record_decode(
-            dt, len(live_before), len(live_before), self.serve.slots
+            dt, new_total, len(live_before), self.serve.slots
         )
         hbm.sample()  # stride-counted device-memory reading (no sync)
         if hmon:
@@ -1075,11 +1217,19 @@ class Engine:
             )
         series.sample()  # stride-counted scrape of the attached sources
         self._h_step.observe(dt)
-        self._c_tokens.inc(len(live_before))
+        self._c_tokens.inc(new_total)
         for s in live_before:
-            self._slot_len[s] += 1
-            self._completions[self._slot_rid[s]].tokens.append(int(toks_np[s]))
-            self._slot_remaining[s] -= 1
+            if spec_step:
+                n = int(emit_np[s])
+                new_toks = [int(t) for t in toks_np[s, :n]]
+            else:
+                n = 1
+                new_toks = [int(toks_np[s])]
+            self._slot_len[s] += n
+            self._completions[self._slot_rid[s]].tokens.extend(new_toks)
+            if self.serve.spec:
+                self._slot_ctx[s].extend(new_toks)
+            self._slot_remaining[s] -= n
             if done_np[s]:
                 self._finish(s, "eos")
             elif self._slot_remaining[s] <= 0:
@@ -1370,8 +1520,8 @@ def _decode_step(params, cache: PagedKVCache, table, state: _SlotState, *,
         v_new = (h @ lp["wv"]).reshape(S, Hkv, hd)
         # per-row scatter into the pool (advanced indices pid/off move the
         # row dim to the front: the slice value is [S, Hkv, hd] directly)
-        k_pool = k_pool.at[pid, :, off, :].set(k_new)
-        v_pool = v_pool.at[pid, :, off, :].set(v_new)
+        k_pool = scatter_block_kv(k_pool, k_new, pid, off)
+        v_pool = scatter_block_kv(v_pool, v_new, pid, off)
         attn = decode_attention(
             q, k_pool, v_pool, pos + 1, tables=table,
             impl=decode_impl, block=kv_block,
@@ -1400,6 +1550,141 @@ def _decode_step(params, cache: PagedKVCache, table, state: _SlotState, *,
     hmon = health.decode_monitors(logits) if monitors else {}
     return PagedKVCache(new_k, new_v, lengths), new_state, nxt, hmon
 
+
+def _spec_decode_step(params, cache: PagedKVCache, table, state: _SlotState,
+                      drafts, draft_len, *, cfg: LlamaConfig,
+                      decode_impl: str, kv_block: int, max_top_k: int,
+                      draft_k: int, monitors: bool = False):
+    """The speculative verify step: feed every row G = draft_k + 1 tokens
+    (its last sampled token + its k drafts, short drafts padded), write
+    their K/V at positions pos..pos+k, attend all G query positions in
+    ONE widened forward (ops/decode_attention.py's multi-query form),
+    then run the rejection rule (serve/spec.py) so the emitted prefix is
+    draw-for-draw what the 1-wide step would have sampled. Rollback is
+    free: ``lengths`` advance by exactly the emitted count, so rejected
+    positions' K/V sit beyond every length mask and are overwritten by
+    later steps; padding positions past a row's draft length steer to the
+    scratch block and never touch real storage at all."""
+    S = state.last_tok.shape[0]
+    G = draft_k + 1
+    hd, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    # fed tokens: [last_tok, d_1 .. d_k] — token j conditions position
+    # pos + j and its logits score the candidate at pos + j + 1
+    tokens_in = jnp.concatenate([state.last_tok[:, None], drafts], axis=1)
+    x = params["tok_emb"][tokens_in]                       # [S, G, D]
+    pos0 = cache.lengths                                   # [S]
+    goff = jnp.arange(G, dtype=jnp.int32)
+    pos = pos0[:, None] + goff[None, :]                    # [S, G]
+    ang = pos.astype(jnp.float32)[..., None] * rope_freqs(cfg)[None, None, :]
+    cos = jnp.cos(ang)[:, :, None, :]                      # [S, G, 1, half]
+    sin = jnp.sin(ang)[:, :, None, :]
+
+    def rope(t):  # [S, G, H', hd], per-position angle
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [t1 * cos - t2 * sin, t2 * cos + t1 * sin], axis=-1
+        ).astype(t.dtype)
+
+    # paged write targets: position g of row s lands in physical block
+    # table[s, (pos0+g) // block] at offset (pos0+g) % block; dead rows
+    # and padding positions past the row's draft length steer to scratch
+    bi = pos // kv_block
+    off = pos % kv_block
+    write_ok = state.live[:, None] & (goff[None, :] <= draft_len[:, None])
+    M = table.shape[1]
+    pid = jnp.where(
+        write_ok,
+        jnp.take_along_axis(table, jnp.minimum(bi, M - 1), axis=1),
+        SCRATCH_BLOCK,
+    )
+    off = jnp.where(write_ok, off, 0)
+
+    def block(x, layer):
+        lp, k_pool, v_pool = layer
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = rope((h @ lp["wq"]).reshape(S, G, H, hd))
+        k_new = rope((h @ lp["wk"]).reshape(S, G, Hkv, hd))
+        v_new = (h @ lp["wv"]).reshape(S, G, Hkv, hd)
+        k_pool = scatter_block_kv(k_pool, k_new, pid, off)
+        v_pool = scatter_block_kv(v_pool, v_new, pid, off)
+        # multi-query paged attention: query g of row s sees positions
+        # < pos0[s] + g + 1 (lengths arg = pos0 + G, kernel offsets per g)
+        attn = decode_attention(
+            q, k_pool, v_pool, pos0 + G, tables=table,
+            impl=decode_impl, block=kv_block,
+        )
+        x = x + attn.reshape(S, G, H * hd) @ lp["wo"]
+        h2 = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        delta = (jax.nn.silu(h2 @ lp["w1"]) * (h2 @ lp["w3"])) @ lp["w2"]
+        return x + delta, (k_pool, v_pool)
+
+    x, (new_k, new_v) = lax.scan(
+        block, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)   # [S, G, V]
+
+    toks, n_emit, _n_acc, last_tok, new_rng, done = verify_and_accept(
+        logits, drafts, draft_len, state, max_top_k=max_top_k,
+    )
+    live = state.live
+    lengths = pos0 + n_emit * live.astype(jnp.int32)
+    new_state = state._replace(
+        last_tok=jnp.where(live, last_tok, state.last_tok),
+        rng=jnp.where(live[:, None], new_rng, state.rng),
+        done=jnp.where(live, done, state.done),
+    )
+    if monitors:
+        # health rules judge the step by the LAST emitted position's
+        # logits — the same autoregressive frontier the 1-wide step
+        # reports, so accepted drafts can't trip entropy/nonfinite rules
+        last_idx = jnp.maximum(n_emit - 1, 0)
+        frontier = jnp.take_along_axis(
+            logits, last_idx[:, None, None], axis=1
+        )[:, 0]
+        hmon = health.decode_monitors(frontier)
+    else:
+        hmon = {}
+    return PagedKVCache(new_k, new_v, lengths), new_state, toks, n_emit, hmon
+
+
+@functools.lru_cache(maxsize=512)
+def _spec_decode_fn(cfg: LlamaConfig, decode_impl: str, kv_block: int,
+                    max_top_k: int, draft_k: int, monitors: bool = False):
+    """Jitted speculative verify step — same cache discipline as
+    :func:`_decode_fn` (per model/kernel knobs, table not donated)."""
+    return jax.jit(
+        partial(
+            _spec_decode_step, cfg=cfg, decode_impl=decode_impl,
+            kv_block=kv_block, max_top_k=max_top_k, draft_k=draft_k,
+            monitors=monitors,
+        ),
+        donate_argnums=(1, 3),
+    )
+
+
+def _aot_spec_decode(cfg: LlamaConfig, decode_impl: str, kv_block: int,
+                     max_top_k: int, draft_k: int, params, cache, table,
+                     state, ledger, *, monitors: bool = False):
+    fn = _spec_decode_fn(cfg, decode_impl, kv_block, max_top_k, draft_k,
+                         monitors)
+    S = state.last_tok.shape[0]
+    try:
+        shard = jax.tree.leaves(params)[0].sharding
+        key = ("spec", cfg, decode_impl, kv_block, max_top_k, draft_k,
+               monitors, cache.k.shape, str(cache.k.dtype), table.shape,
+               hash(shard), shard)
+    except Exception:
+        return fn
+    name = (f"serve.decode_spec[slots={S},blocks={cache.k.shape[1]},"
+            f"attended={table.shape[1]},k={draft_k}]")
+    avals = (
+        params, cache, table, state,
+        _sds((S, draft_k), jnp.int32), _sds((S,), jnp.int32),
+    )
+    return _aot_compile(
+        fn, avals, key, name, ledger, cache=_aot_decode_cache,
+    )
 
 
 __all__ = [

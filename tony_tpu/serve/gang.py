@@ -83,6 +83,10 @@ class GangSettings:
     prefix_budget_mb: float = 64.0
     prefix_affinity: bool = True
     prefix_fingerprint_tokens: int = 64
+    # speculative decoding (serve/spec.py; serve.spec.* keys)
+    spec: bool = False
+    spec_max_draft: int = 4
+    spec_draft_source: str = "auto"
 
     @classmethod
     def from_config(cls, config: TonyConfig) -> "GangSettings":
@@ -117,6 +121,11 @@ class GangSettings:
             prefix_affinity=config.get_bool(Keys.SERVE_PREFIX_AFFINITY, True),
             prefix_fingerprint_tokens=config.get_int(
                 Keys.SERVE_PREFIX_FINGERPRINT_TOKENS, 64
+            ),
+            spec=config.get_bool(Keys.SERVE_SPEC_ENABLED, False),
+            spec_max_draft=config.get_int(Keys.SERVE_SPEC_MAX_DRAFT, 4),
+            spec_draft_source=config.get_str(
+                Keys.SERVE_SPEC_DRAFT_SOURCE, "auto"
             ),
         )
 
@@ -161,6 +170,8 @@ def build_gang_engine(settings: GangSettings) -> "Engine":
             slots=settings.slots, max_len=settings.max_len,
             max_queue=settings.max_queue, prefix=settings.prefix,
             prefix_budget_mb=settings.prefix_budget_mb,
+            spec=settings.spec, spec_max_draft=settings.spec_max_draft,
+            spec_draft_source=settings.spec_draft_source,
         ),
     )
 

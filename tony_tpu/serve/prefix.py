@@ -118,43 +118,59 @@ class PrefixStore:
 
     # --- matching -------------------------------------------------------------
 
+    def _walk_locked(
+        self, tokens: Sequence[int], limit: int
+    ) -> tuple[_Node, list[_Node], int, "_Node | None", int]:
+        """THE radix walk (caller holds ``_lock``): full chunks by hash,
+        then longest-common-prefix into the best child — shared by
+        admission matching and the speculative draft source
+        (``longest_extension``) so the two can never diverge. Returns
+        ``(node, full_nodes, matched, best, best_cp)``: the deepest
+        fully-matched node, the full-chunk chain under it, total tokens
+        matched, and the partially-entered child (``best_cp`` of its
+        chunk consumed) or None when the match ends on a boundary."""
+        B = self.block
+        node = self._root
+        full_nodes: list[_Node] = []
+        i = 0
+        while i < limit:
+            if limit - i >= B:
+                child = node.children.get(tuple(tokens[i:i + B]))
+                if child is not None:
+                    node = child
+                    full_nodes.append(node)
+                    i += B
+                    continue
+            # no full-chunk match left: extend into the best child by
+            # longest common token prefix (the mid-block / COW case)
+            want = tuple(tokens[i:limit])
+            best_cp = 0
+            best: _Node | None = None
+            for child in node.children.values():
+                cp = _common_prefix(child.chunk, want)
+                if cp > best_cp:
+                    best_cp, best = cp, child
+            return node, full_nodes, i + best_cp, best, best_cp
+        return node, full_nodes, i, None, 0
+
     def match(self, tokens: Sequence[int], limit: int) -> MatchResult:
         """Longest cached prefix of ``tokens[:limit]``. ``limit`` is the
         admission cap (``plen - 1``: at least one token must remain for
         prefill to compute the first sampled logits). Accounts the hit
         into the hit-rate counters."""
-        B = self.block
         full: list[int] = []
         partial: int | None = None
         with self._lock:
             self._clock += 1
-            node = self._root
-            i = 0
-            while i < limit:
-                if limit - i >= B:
-                    child = node.children.get(tuple(tokens[i:i + B]))
-                    if child is not None:
-                        node = child
-                        node.last_used = self._clock
-                        node.hits += 1
-                        full.append(node.phys)
-                        i += B
-                        continue
-                # no full-chunk match left: extend into the best child by
-                # longest common token prefix (the mid-block / COW case)
-                want = tuple(tokens[i:limit])
-                best_cp = 0
-                best: _Node | None = None
-                for child in node.children.values():
-                    cp = _common_prefix(child.chunk, want)
-                    if cp > best_cp:
-                        best_cp, best = cp, child
-                if best is not None:
-                    best.last_used = self._clock
-                    best.hits += 1
-                    partial = best.phys
-                    i += best_cp
-                break
+            node, full_nodes, i, best, _cp = self._walk_locked(tokens, limit)
+            for n in full_nodes:
+                n.last_used = self._clock
+                n.hits += 1
+                full.append(n.phys)
+            if best is not None:
+                best.last_used = self._clock
+                best.hits += 1
+                partial = best.phys
             # touch the matched chain so no ancestor is ever older than a
             # descendant (eviction is leaf-first, LRU by leaf)
             walk = node
@@ -162,6 +178,40 @@ class PrefixStore:
                 walk.last_used = self._clock
                 walk = walk.parent
         return MatchResult(i, tuple(full), partial)
+
+    def longest_extension(self, tokens: Sequence[int], max_k: int) -> list[int]:
+        """Up to ``max_k`` tokens the store predicts follow ``tokens``:
+        walk the radix path the WHOLE context follows (the exact
+        ``match`` semantics via ``_walk_locked`` — full chunks by hash,
+        then longest common prefix into the best child, so a context may
+        end mid-block), then read onward along the tree, descending into
+        the most-hit child at each node boundary. Returns ``[]`` when the
+        context leaves the tree — the store has never observed any
+        continuation of it. The speculative draft source (serve/spec.py):
+        pure host-side python (GL001), and read-only — drafting touches
+        neither the LRU clock nor the hit counters, so it cannot perturb
+        eviction order or the admission hit-rate."""
+        if max_k <= 0:
+            return []
+        out: list[int] = []
+        with self._lock:
+            node, _full, matched, best, best_cp = self._walk_locked(
+                tokens, len(tokens)
+            )
+            if matched != len(tokens):
+                return []
+            if best is not None:
+                # mid-block end: the remainder of the partially-entered
+                # chunk is the first (and already-ordered) continuation
+                out.extend(best.chunk[best_cp:])
+                node = best
+            while len(out) < max_k and node.children:
+                node = max(
+                    node.children.values(),
+                    key=lambda c: (c.hits, c.last_used),
+                )
+                out.extend(node.chunk)
+        return out[:max_k]
 
     def record_prompt(self, plen: int, hit: int) -> None:
         """Hit-rate accounting: ``hit`` of ``plen`` prompt tokens were
