@@ -674,6 +674,60 @@ def decode_bench(on_tpu: bool) -> dict:
             )
     out["spec_trace"] = spec_out
 
+    # (f) quantized serving (serve.quant.*: block-scaled int8 KV pools in
+    # serve/cache.py + weight-only int8 decode matmuls in ops/quant_mm.py):
+    # the same warmed trace, quant on vs off. ``tolerance`` is the STATED
+    # quant-vs-bf16 logits bound the kernels hold (tests/test_quant.py
+    # asserts it; perf-diff treats it as config identity, so loosening it
+    # is a diff failure, not drift). Each mode runs under its own HBM
+    # phase so peak_hbm_gb is scoped per mode, not inherited.
+    QUANT_TOL = 0.08
+
+    def qreqs(seed):
+        r2 = np.random.default_rng(seed)
+        return [
+            Request(
+                prompt=r2.integers(
+                    0, cfg.vocab_size, prompt_lens[i % len(prompt_lens)]
+                ),
+                max_new_tokens=max_new, rng=seed * 1000 + i,
+            )
+            for i in range(n_req)
+        ]
+
+    def quant_mode(on: bool) -> dict:
+        name = "decode.quant_on" if on else "decode.quant_off"
+        with _hbm_watch().phase(name) as ph:
+            eng = Engine(params, cfg, ServeConfig(
+                slots=slots, max_len=max_len, kv_block=block,
+                quant_kv="int8" if on else "", quant_weights=on,
+            ))
+            eng.run(qreqs(3))  # warm: compiles paid before timing
+            eng.reset_metrics()
+            eng.run(qreqs(4))
+            m = eng.metrics
+            r = {
+                "tok_s_slot": round(m.tokens_per_sec_per_chip / slots, 1),
+                "ttft_avg_s": round(m.ttft_avg_s, 5),
+                "kv_bytes_per_token": round(m.kv_bytes_per_token, 1),
+            }
+            eng.close()
+        hk = ph.bench_keys()
+        if hk:
+            r["peak_hbm_gb"] = hk["phase_peak_hbm_gb"]
+        return r
+
+    q_on, q_off = quant_mode(True), quant_mode(False)
+    quant_out: dict = {
+        "kv_dtype": "int8", "tolerance": QUANT_TOL,
+        "quant_on": q_on, "quant_off": q_off,
+    }
+    if q_off["tok_s_slot"] > 0:
+        quant_out["tok_s_ratio"] = round(
+            q_on["tok_s_slot"] / q_off["tok_s_slot"], 3
+        )
+    out["quant"] = quant_out
+
     # native-GQA decode kernel vs the repeat-expanded reference (one
     # decode step of attention at full cache length, layer-scanned so
     # dispatch overhead amortises)
@@ -757,9 +811,14 @@ def gqa_capacity_demo() -> dict:
         # shared_prefix_tokens: the prefix-store accounting — slot budget
         # when every request carries a half-max_len shared template prefix
         # (one refcounted physical copy; each slot pays only its tail)
+        # quant_kv adds the quantized decode step's own budget (int8
+        # pools + scale rows, measured via the same slots=1/2 plan
+        # differencing): max_slots_quant and quant_slot_ratio are the
+        # capacity headline of ROADMAP item 4
         measured = derive_slot_budget(
             cfg, max_len=max_len, hbm_bytes=hbm,
             shared_prefix_tokens=max_len // 2,
+            quant_kv="int8",
         )
         out.update(measured)
         out["param_gb"] = round(measured["param_bytes"] / 2**30, 2)

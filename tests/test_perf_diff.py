@@ -88,6 +88,24 @@ class TestFlattenAndRules:
         assert rule_for(
             "extra.decode.spec_trace.b1_on.decode_compiles"
         )[0] == "lower"
+        # quantized serving (serve/cache.py, bench decode.quant +
+        # gqa_capacity): the measured slot budget and the quant/bf16
+        # ratio are higher-better — they carry no memory token, so
+        # without their own rule a budget collapse would go unjudged;
+        # the stated accuracy tolerance and KV dtype are configuration
+        # identity (loosening the tolerance must be a visible config
+        # change, never judged "within tolerance")
+        assert rule_for("extra.gqa_capacity.max_slots_quant")[0] == "higher"
+        assert rule_for("extra.gqa_capacity.max_slots_native")[0] == "higher"
+        assert rule_for("extra.gqa_capacity.quant_slot_ratio")[0] == "higher"
+        assert rule_for("extra.decode.quant.tolerance")[0] == "config"
+        assert rule_for("extra.decode.quant.quant_on.tok_s_slot")[0] == "higher"
+        assert rule_for(
+            "extra.decode.quant.quant_on.kv_bytes_per_token"
+        )[0] == "lower"
+        assert rule_for(
+            "extra.decode.quant.quant_on.peak_hbm_gb"
+        )[0] == "lower"
 
     def test_headroom_collapse_is_a_regression(self):
         v = diff(
@@ -134,6 +152,12 @@ class TestVerdict:
         assert "extra.decode.spec_trace.b1_on.accept_rate" in keys
         assert "extra.decode.spec_trace.b1_on.tokens_per_step" in keys
         assert "extra.decode.spec_trace.speedup_b1" in keys
+        # the quantized-serving section gates too: a slot-budget collapse
+        # (the capacity headline) and the vanished on/off throughput
+        # advantage both flag
+        assert "extra.gqa_capacity.max_slots_quant" in keys
+        assert "extra.gqa_capacity.quant_slot_ratio" in keys
+        assert "extra.decode.quant.tok_s_ratio" in keys
         # within-tolerance drift is NOT flagged
         assert "extra.loss" not in keys          # +0.04% << 2%
         assert "extra.peak_hbm_gb" not in keys   # +1.5% << 10%

@@ -230,6 +230,20 @@ class Keys:
     # (store only) | ngram (the slot's own prompt-lookup only)
     SERVE_SPEC_DRAFT_SOURCE = "serve.spec.draft_source"
 
+    # --- quantized serving (block-scaled KV + weight-only int8;
+    #     serve/cache.py, ops/quant_mm.py, docs/SERVE.md) ---
+    # quantize the paged KV cache at physical-block granularity: int8/fp8
+    # pools with per-block-per-head float32 scales; decode attention
+    # dequantizes inline, roughly doubling the slot budget at a bounded
+    # logits drift (bench decode.quant states the tolerance)
+    SERVE_QUANT_ENABLED = "serve.quant.enabled"
+    # KV storage dtype: int8 | fp8_e4m3 (fp8 needs a jax with
+    # jnp.float8_e4m3fn; the engine refuses rather than silently widening)
+    SERVE_QUANT_KV_DTYPE = "serve.quant.kv_dtype"
+    # also run decode/verify matmuls on int8 weights with per-output-
+    # channel scales (prefill keeps the bf16 master weights)
+    SERVE_QUANT_WEIGHTS = "serve.quant.weights"
+
     # --- cluster backend ---
     # Deliberate non-goals vs the reference key surface: docker keys (no
     # container runtime in this environment — processes are the container
@@ -388,6 +402,9 @@ DEFAULTS: dict[str, object] = {
     Keys.SERVE_SPEC_ENABLED: False,
     Keys.SERVE_SPEC_MAX_DRAFT: 4,
     Keys.SERVE_SPEC_DRAFT_SOURCE: "auto",
+    Keys.SERVE_QUANT_ENABLED: False,
+    Keys.SERVE_QUANT_KV_DTYPE: "int8",
+    Keys.SERVE_QUANT_WEIGHTS: False,
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",

@@ -144,6 +144,7 @@ def generate(
     rng: jax.Array | None = None,
     max_len: int = 0,
     max_top_k: int = 0,
+    serve: dict | None = None,
 ) -> jax.Array:
     """Autoregressive generation. prompt [B,P] -> [B, P+max_new_tokens].
 
@@ -162,6 +163,13 @@ def generate(
     ``max(top_k, DEFAULT_NUCLEUS_K)``): top-p-only sampling truncates to
     the top ``max_top_k`` logits before the nucleus cut, so callers who
     need a wider nucleus than the top-64 tail raise it here.
+
+    ``serve`` overrides ServeConfig fields on the underlying engine (e.g.
+    ``dict(quant_kv="int8", quant_weights=True)``). This is how quantized
+    serving stays a TESTABLE parity surface: a quantized engine can never
+    be token-exact against a bf16 reference, but generate() with the same
+    overrides runs the identical quantized step — so engine-vs-generate
+    parity remains exact equality, quantization and all.
     """
     from tony_tpu.serve.engine import Engine, Request, ServeConfig
 
@@ -173,12 +181,14 @@ def generate(
         rng = jax.random.key(0)
     keys = jax.random.split(rng, B)
 
-    engine = Engine(params, cfg, ServeConfig(
+    sv = dict(
         slots=B,
         max_len=max_len or max(total, 1),
         prefill_buckets=(P,),
         max_top_k=max(top_k, max_top_k, DEFAULT_NUCLEUS_K),
-    ))
+    )
+    sv.update(serve or {})
+    engine = Engine(params, cfg, ServeConfig(**sv))
     prompt_np = np.asarray(prompt)
     ids = [
         engine.submit(Request(

@@ -109,6 +109,8 @@ class DecodeMetrics:
     draft_proposed: int = 0        # speculative draft tokens proposed
     draft_accepted: int = 0        # ... of which the target accepted
     spec_rollbacks: int = 0        # ... of which were rejected (discarded)
+    kv_bytes_per_token: float = 0.0  # HBM per cached token (block bytes /
+    #                                  positions; halves with quantized pools)
 
     def record_prompt(self, plen: int, hit_tokens: int = 0) -> None:
         self.prompt_tokens += plen
@@ -203,4 +205,6 @@ class DecodeMetrics:
         if self.draft_proposed:
             out["draft_accept_rate"] = round(self.draft_accept_rate, 4)
             out["spec_rollbacks"] = self.spec_rollbacks
+        if self.kv_bytes_per_token:
+            out["kv_bytes_per_token"] = round(self.kv_bytes_per_token, 2)
         return out

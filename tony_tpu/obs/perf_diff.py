@@ -92,6 +92,17 @@ DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
     (r"(max_draft|gen_tokens)", "config", 0.0),
     (r"(tokens_per_step|accept_rate|speedup)", "higher", 0.05),
     (r"(spec_rollbacks|draft_proposed|draft_accepted)", "skip", 0.0),
+    # quantized serving (serve/cache.py int8/fp8 KV, bench
+    # `decode.quant` + `gqa_capacity`): the slot budget — measured
+    # max_slots_* and the quant/bf16 ratio — is the capacity headline,
+    # higher is better, and it must outrank the memory rule (the keys
+    # carry no memory token but a budget collapse must not go unjudged).
+    # The stated accuracy tolerance and the KV storage dtype are
+    # configuration identity: silently loosening the tolerance (or
+    # switching int8 -> fp8) would make a worse kernel look "within
+    # tolerance", so drift is a diff failure, not a judged metric.
+    (r"(max_slots|slot_ratio)", "higher", 0.05),
+    (r"(quant_kv$|tolerance)", "config", 0.0),
     # memory: lower is better, generous tolerance (allocator noise)
     (r"(hbm|bytes|_gb$|_mb$|rss)", "lower", 0.10),
     # compile counts: lower is better (a silent recompile regression)

@@ -153,9 +153,13 @@ def _decode_scan(q, k, v, lengths, *, scale, block):
     return out.reshape(B, G, H, hd).astype(q.dtype)
 
 
-def _paged_scan(q, k, v, lengths, tables, *, scale):
+def _paged_scan(q, k, v, lengths, tables, *, scale, k_scale=None,
+                v_scale=None):
     """Online-softmax scan over *logical* blocks, each row's block gathered
-    through its table entry (native GQA contraction, paged pools)."""
+    through its table entry (native GQA contraction, paged pools). Given
+    ``k_scale``/``v_scale`` ``[P, Hkv]`` the pools are quantized: the scale
+    row is gathered right next to the block gather and the tile is
+    dequantized in registers (serve/cache.py block-scaled quantization)."""
     B, G, H, hd = q.shape
     Hkv, blk = k.shape[1], k.shape[2]
     rep = H // Hkv
@@ -172,6 +176,11 @@ def _paged_scan(q, k, v, lengths, tables, *, scale):
         pid = lax.dynamic_index_in_dim(tables, j, axis=1, keepdims=False)
         kb = jnp.take(k, pid, axis=0)                          # [B, Hkv, blk, hd]
         vb = jnp.take(v, pid, axis=0)
+        if k_scale is not None:
+            ksc = jnp.take(k_scale, pid, axis=0)               # [B, Hkv]
+            vsc = jnp.take(v_scale, pid, axis=0)
+            kb = (kb.astype(jnp.float32) * ksc[..., None, None]).astype(qg.dtype)
+            vb = (vb.astype(jnp.float32) * vsc[..., None, None]).astype(qg.dtype)
         s = jnp.einsum(
             "bgxrd,bxkd->bgxrk", qg, kb, preferred_element_type=jnp.float32
         ) * scale
@@ -292,11 +301,11 @@ def _decode_pallas(q, k, v, lengths, *, scale, block):
     )
 
 
-def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc,
-                  l_sc, *, scale, block, kv_heads, rep, queries):
-    i, j = pl.program_id(0), pl.program_id(1)
-    nb = pl.num_programs(1)
-    row_len = len_ref[i // kv_heads]
+def _paged_body(j, nb, row_len, q_ref, read_kv, o_ref, acc, m_sc, l_sc,
+                *, scale, block, rep, queries):
+    """Shared paged tile body: ``read_kv`` hands back this tile's (k, v) in
+    the query dtype — the plain kernel reads the refs directly, the quant
+    kernel dequantizes through its scale refs first."""
 
     @pl.when(j == 0)
     def _init():
@@ -306,7 +315,8 @@ def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc,
 
     @pl.when(j * block < row_len)
     def _block():
-        q, k, v = q_ref[0], k_ref[0, 0], v_ref[0, 0]
+        q = q_ref[0]
+        k, v = read_kv()
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                      # [queries*rep, block]
@@ -333,36 +343,92 @@ def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc,
         o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
 
 
-def _paged_pallas(q, k, v, lengths, tables, *, scale):
+def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc,
+                  l_sc, *, scale, block, kv_heads, rep, queries):
+    i, j = pl.program_id(0), pl.program_id(1)
+    nb = pl.num_programs(1)
+    row_len = len_ref[i // kv_heads]
+    _paged_body(
+        j, nb, row_len, q_ref, lambda: (k_ref[0, 0], v_ref[0, 0]),
+        o_ref, acc, m_sc, l_sc,
+        scale=scale, block=block, rep=rep, queries=queries,
+    )
+
+
+def _paged_quant_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, ksc_ref,
+                        vsc_ref, o_ref, acc, m_sc, l_sc, *, scale, block,
+                        kv_heads, rep, queries):
+    """Quantized pools: the (1, 1) scale tiles ride BlockSpecs steered by
+    the same table lookup as their K/V tiles, so the per-block-per-head
+    scale arrives alongside the int8/fp8 payload and the dequant happens in
+    registers — the bf16 cache never exists in HBM."""
+    i, j = pl.program_id(0), pl.program_id(1)
+    nb = pl.num_programs(1)
+    row_len = len_ref[i // kv_heads]
+
+    def read_kv():
+        k = (k_ref[0, 0].astype(jnp.float32) * ksc_ref[0, 0]).astype(
+            q_ref.dtype
+        )
+        v = (v_ref[0, 0].astype(jnp.float32) * vsc_ref[0, 0]).astype(
+            q_ref.dtype
+        )
+        return k, v
+
+    _paged_body(
+        j, nb, row_len, q_ref, read_kv, o_ref, acc, m_sc, l_sc,
+        scale=scale, block=block, rep=rep, queries=queries,
+    )
+
+
+def _paged_pallas(q, k, v, lengths, tables, *, scale, k_scale=None,
+                  v_scale=None):
     """Grid (B * Hkv, M): the table rides as scalar prefetch and its values
     steer the K/V BlockSpec index map, so each tile's DMA fetches the
-    physical block the row's table names (no gather materialised)."""
+    physical block the row's table names (no gather materialised). With
+    ``k_scale``/``v_scale`` ``[P, Hkv]`` the same table-steered index map
+    carries each tile's scale scalar in as a (1, 1) block."""
     B, G, H, hd = q.shape
     Hkv, blk = k.shape[1], k.shape[2]
     rep = H // Hkv
     nb = tables.shape[1]
     R = G * rep
+    quant = k_scale is not None
     qf = q.reshape(B, G, Hkv, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
         B * Hkv, R, hd
     )
+
+    def kv_spec():
+        return pl.BlockSpec(
+            (1, 1, blk, hd),
+            lambda i, j, ln, tb, kv_heads=Hkv: (
+                tb[i // kv_heads, j], i % kv_heads, 0, 0
+            ),
+        )
+
+    def scale_spec():
+        return pl.BlockSpec(
+            (1, 1),
+            lambda i, j, ln, tb, kv_heads=Hkv: (
+                tb[i // kv_heads, j], i % kv_heads
+            ),
+        )
+
+    in_specs = [
+        pl.BlockSpec((1, R, hd), lambda i, j, ln, tb: (i, 0, 0)),
+        kv_spec(),
+        kv_spec(),
+    ]
+    operands = [qf, k, v]
+    kernel = _paged_kernel
+    if quant:
+        in_specs += [scale_spec(), scale_spec()]
+        operands += [k_scale, v_scale]
+        kernel = _paged_quant_kernel
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B * Hkv, nb),
-        in_specs=[
-            pl.BlockSpec((1, R, hd), lambda i, j, ln, tb: (i, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, blk, hd),
-                lambda i, j, ln, tb, kv_heads=Hkv: (
-                    tb[i // kv_heads, j], i % kv_heads, 0, 0
-                ),
-            ),
-            pl.BlockSpec(
-                (1, 1, blk, hd),
-                lambda i, j, ln, tb, kv_heads=Hkv: (
-                    tb[i // kv_heads, j], i % kv_heads, 0, 0
-                ),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, R, hd), lambda i, j, ln, tb: (i, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((R, hd), jnp.float32),
@@ -372,7 +438,7 @@ def _paged_pallas(q, k, v, lengths, tables, *, scale):
     )
     out = pl.pallas_call(
         functools.partial(
-            _paged_kernel, scale=scale, block=blk, kv_heads=Hkv,
+            kernel, scale=scale, block=blk, kv_heads=Hkv,
             rep=rep, queries=G,
         ),
         grid_spec=grid_spec,
@@ -381,7 +447,7 @@ def _paged_pallas(q, k, v, lengths, tables, *, scale):
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=_use_interpret(),
-    )(lengths.astype(jnp.int32), tables.astype(jnp.int32), qf, k, v)
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32), *operands)
     return out.reshape(B, Hkv, G, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
         B, G, H, hd
     )
@@ -400,6 +466,8 @@ def decode_attention(
     impl: str = "scan",
     block: int = 128,
     scale: float | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """One decode step of attention at native GQA width.
 
@@ -421,6 +489,12 @@ def decode_attention(
     Returns [B, G, H, head_dim]. Works in both contiguous and paged form;
     both impls fold the G positions into the existing tile rows, so the
     per-step K/V traffic does not grow with G.
+
+    Quantized paged form (``k_scale``/``v_scale [P, Hkv]`` given, paged
+    only): the pools hold int8 or fp8 payloads quantized per physical
+    block per kv-head (serve/cache.py); both impls dequantize each tile
+    inline — scan gathers the scale row next to the block gather, pallas
+    threads the scale pools through the same table-steered index map.
     """
     squeeze = q.ndim == 3
     if squeeze:
@@ -430,6 +504,12 @@ def decode_attention(
         scale = 1.0 / math.sqrt(hd)
     if impl not in ("scan", "pallas"):
         raise ValueError(f"unknown decode impl {impl!r} (expected scan | pallas)")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if k_scale is not None and tables is None:
+        raise ValueError(
+            "quantized decode_attention requires the paged form (tables)"
+        )
     if tables is not None:
         if k.shape != v.shape or k.shape[3] != hd:
             raise ValueError(
@@ -445,9 +525,15 @@ def decode_attention(
                 f"n_heads {H} not a multiple of n_kv_heads {k.shape[1]}"
             )
         if impl == "pallas":
-            out = _paged_pallas(q, k, v, lengths, tables, scale=scale)
+            out = _paged_pallas(
+                q, k, v, lengths, tables, scale=scale,
+                k_scale=k_scale, v_scale=v_scale,
+            )
         else:
-            out = _paged_scan(q, k, v, lengths, tables, scale=scale)
+            out = _paged_scan(
+                q, k, v, lengths, tables, scale=scale,
+                k_scale=k_scale, v_scale=v_scale,
+            )
         return out[:, 0] if squeeze else out
     if k.shape != v.shape or k.shape[0] != B or k.shape[3] != hd:
         raise ValueError(f"decode_attention shapes q={q.shape} k={k.shape} v={v.shape}")

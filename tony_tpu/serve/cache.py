@@ -32,6 +32,20 @@ as the substrate for SGLang-style radix prefix sharing, arXiv:2312.07104):
 The sharing policy itself (which blocks are safe to share, copy-on-write,
 eviction) lives in serve/prefix.py; this module only knows physical blocks
 and reference counts.
+
+**Quantized pools** (ROADMAP quantized serving): with ``quant_kv`` set the
+K/V pools store int8 (or fp8 ``e4m3``) and a parallel *scale pool*
+``[L, P, Hkv]`` float32 carries one scale per physical block per kv head —
+block granularity because the block is already the unit of allocation,
+sharing, and copy-on-write, so a shared block carries its scales with it
+and a COW copy duplicates exactly one scale row. Writes quantize in place
+(:func:`scatter_block_kv` with ``k_scale`` given): the written positions'
+amax folds into the running block scale, and when the scale grows the
+block's existing entries requantize by ``old/new`` (exactly a no-op when
+the scale is unchanged — round(q * 1.0) == q). A scale of zero marks a
+block with no real content (freshly allocated, never written): requantizing
+by ``0/new`` zeroes whatever garbage a reused block carried, so the engine
+only has to zero the scale row at allocation, never the block itself.
 """
 
 from __future__ import annotations
@@ -47,13 +61,40 @@ import jax.numpy as jnp
 # by the per-row length, but the DMA still needs a valid index)
 SCRATCH_BLOCK = 0
 
+# kv_dtype knob values -> (storage dtype, largest representable magnitude).
+# fp8 e4m3 is the stretch format behind the same knob; it only registers
+# where the jax build carries the dtype (kv_quant_spec raises otherwise).
+KV_QUANT_DTYPES = ("int8", "fp8_e4m3")
+
+
+def kv_quant_spec(kv_dtype: str) -> tuple[jnp.dtype, float]:
+    """Resolve a ``serve.quant.kv_dtype`` value to (storage dtype, qmax)."""
+    if kv_dtype == "int8":
+        return jnp.dtype(jnp.int8), 127.0
+    if kv_dtype == "fp8_e4m3":
+        if not hasattr(jnp, "float8_e4m3fn"):
+            raise ValueError(
+                "kv_dtype 'fp8_e4m3' needs a jax build with float8_e4m3fn"
+            )
+        return jnp.dtype(jnp.float8_e4m3fn), 448.0
+    raise ValueError(
+        f"unknown kv quant dtype {kv_dtype!r} (expected one of "
+        f"{KV_QUANT_DTYPES})"
+    )
+
 
 class PagedKVCache(NamedTuple):
-    """k/v: [L, P, Hkv, block, hd] physical-block pools; lengths: [S]."""
+    """k/v: [L, P, Hkv, block, hd] physical-block pools; lengths: [S].
+
+    Quantized pools additionally carry ``k_scale``/``v_scale``
+    ``[L, P, Hkv]`` float32 — one dequantization scale per physical block
+    per kv head (None on an unquantized cache)."""
 
     k: jax.Array
     v: jax.Array
     lengths: jax.Array
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
 
     @property
     def n_blocks(self) -> int:
@@ -69,12 +110,28 @@ class PagedKVCache(NamedTuple):
     def slots(self) -> int:
         return self.lengths.shape[0]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
 
 def create_cache(
-    cfg, slots: int, n_blocks: int, block: int, dtype=None
+    cfg, slots: int, n_blocks: int, block: int, dtype=None,
+    quant_kv: str = "",
 ) -> PagedKVCache:
-    """Fresh pool of ``n_blocks`` physical blocks (block 0 = scratch)."""
+    """Fresh pool of ``n_blocks`` physical blocks (block 0 = scratch).
+    With ``quant_kv`` ('int8' | 'fp8_e4m3') the pools store the quantized
+    dtype plus zeroed per-block-per-head scale pools (scale 0 = block
+    holds nothing real yet)."""
     shape = (cfg.n_layers, n_blocks, cfg.n_kv_heads, block, cfg.head_dim)
+    if quant_kv:
+        qdt, _ = kv_quant_spec(quant_kv)
+        sc = (cfg.n_layers, n_blocks, cfg.n_kv_heads)
+        return PagedKVCache(
+            jnp.zeros(shape, qdt), jnp.zeros(shape, qdt),
+            jnp.zeros((slots,), jnp.int32),
+            jnp.zeros(sc, jnp.float32), jnp.zeros(sc, jnp.float32),
+        )
     dt = dtype or cfg.dtype
     return PagedKVCache(
         jnp.zeros(shape, dt), jnp.zeros(shape, dt),
@@ -88,6 +145,12 @@ def grow_cache(cache: PagedKVCache, n_blocks: int) -> PagedKVCache:
     if extra <= 0:
         return cache
     pad = [(0, 0), (0, extra), (0, 0), (0, 0), (0, 0)]
+    if cache.quantized:
+        spad = pad[:3]
+        return PagedKVCache(
+            jnp.pad(cache.k, pad), jnp.pad(cache.v, pad), cache.lengths,
+            jnp.pad(cache.k_scale, spad), jnp.pad(cache.v_scale, spad),
+        )
     return PagedKVCache(
         jnp.pad(cache.k, pad), jnp.pad(cache.v, pad), cache.lengths
     )
@@ -100,6 +163,11 @@ def shrink_cache(cache: PagedKVCache, n_blocks: int) -> PagedKVCache:
     slot bounds how far the pool can shrink)."""
     if n_blocks >= cache.n_blocks:
         return cache
+    if cache.quantized:
+        return PagedKVCache(
+            cache.k[:, :n_blocks], cache.v[:, :n_blocks], cache.lengths,
+            cache.k_scale[:, :n_blocks], cache.v_scale[:, :n_blocks],
+        )
     return PagedKVCache(
         cache.k[:, :n_blocks], cache.v[:, :n_blocks], cache.lengths
     )
@@ -110,8 +178,61 @@ def blocks_for(length: int, block: int) -> int:
     return max(1, math.ceil(length / block))
 
 
+def quantize_values(vals: jax.Array, scale: jax.Array, qmax: float,
+                    qdtype) -> jax.Array:
+    """``vals / scale`` clipped to the quantized range (rounded for integer
+    storage; fp8 rounds in the cast). ``scale`` broadcasts against
+    ``vals``; a zero scale maps everything to zero (nothing real stored)."""
+    q = vals.astype(jnp.float32) / jnp.maximum(scale, 1e-30)
+    q = jnp.clip(q, -qmax, qmax)
+    # branch is on the STATIC storage dtype, not a traced value
+    if jnp.issubdtype(jnp.dtype(qdtype), jnp.integer):  # graft-lint: disable=GL002
+        q = jnp.round(q)
+    return q.astype(qdtype)
+
+
+def dequantize_values(q: jax.Array, scale: jax.Array, out_dtype) -> jax.Array:
+    """Stored values back to real ones: ``q * scale`` (broadcast)."""
+    return (q.astype(jnp.float32) * scale).astype(out_dtype)
+
+
+def _rescale_stored(q: jax.Array, factor: jax.Array, qmax: float) -> jax.Array:
+    """Requantize stored values by ``factor = old_scale / new_scale``
+    (broadcast). factor == 1 is exact (round(q * 1.0) == q for every
+    representable q); factor == 0 zeroes a block whose scale was 0 —
+    garbage in a freshly allocated block never survives its first write."""
+    f = q.astype(jnp.float32) * factor
+    f = jnp.clip(f, -qmax, qmax)
+    # branch is on the STATIC storage dtype, not a traced value
+    if jnp.issubdtype(q.dtype, jnp.integer):  # graft-lint: disable=GL002
+        f = jnp.round(f)
+    return f.astype(q.dtype)
+
+
+def _quant_write_rows(pool, scale, new, pids, offs, qmax):
+    """One quantized position-per-row write: ``new [S, Hkv, hd]`` lands at
+    ``(pids[s], offs[s])``. Gather the touched blocks + scales, fold the
+    written amax into the running block scale, requantize the existing
+    entries by old/new, insert the quantized row, scatter both back."""
+    S = new.shape[0]
+    blk = jnp.take(pool, pids, axis=0)                  # [S, Hkv, blk, hd]
+    sc = jnp.take(scale, pids, axis=0)                  # [S, Hkv]
+    amax = jnp.max(jnp.abs(new.astype(jnp.float32)), axis=-1)   # [S, Hkv]
+    sc_new = jnp.maximum(sc, amax / qmax)
+    factor = jnp.where(sc_new > 0, sc / jnp.maximum(sc_new, 1e-30), 0.0)
+    blk = _rescale_stored(blk, factor[..., None, None], qmax)
+    row = quantize_values(new, sc_new[..., None], qmax, pool.dtype)
+    # advanced indices (rows on axis 0, offs on axis 2) are non-adjacent:
+    # the indexed result moves them to the front — exactly row's layout
+    blk = blk.at[jnp.arange(S), :, offs, :].set(row)
+    # duplicate pids occur only for scratch-steered rows (dead slots,
+    # padding) — scratch content is garbage by contract, any winner is fine
+    return pool.at[pids].set(blk), scale.at[pids].set(sc_new)
+
+
 def scatter_block_kv(pool: jax.Array, new: jax.Array, pids: jax.Array,
-                     offs: jax.Array) -> jax.Array:
+                     offs: jax.Array, scale: jax.Array | None = None,
+                     qmax: float = 127.0):
     """Paged KV write into ONE layer's ``[P, Hkv, block, hd]`` pool.
 
     ``pids``/``offs`` name each new entry's physical block and in-block
@@ -123,12 +244,65 @@ def scatter_block_kv(pool: jax.Array, new: jax.Array, pids: jax.Array,
     a row's draft length) are the CALLER's job to steer to
     ``SCRATCH_BLOCK``. The advanced indices (``pids`` on axis 0, ``offs``
     on axis 2) are non-adjacent, so the indexed result moves the index
-    dims to the front — exactly ``new``'s layout, no transpose needed."""
-    return pool.at[pids, :, offs, :].set(new)
+    dims to the front — exactly ``new``'s layout, no transpose needed.
+
+    With ``scale`` given (a quantized pool's ``[P, Hkv]`` scale rows for
+    this layer) the write QUANTIZES: the written positions' amax folds
+    into the running block scale, existing entries requantize by
+    old/new, and the return value is ``(pool, scale)``. The speculative
+    2-D form applies the G positions as G sequential single-position
+    passes (G is small and static) so two writes into the same block
+    compound their scale updates correctly."""
+    if scale is None:
+        return pool.at[pids, :, offs, :].set(new)
+    if pids.ndim == 1:
+        return _quant_write_rows(pool, scale, new, pids, offs, qmax)
+    for g in range(pids.shape[1]):
+        pool, scale = _quant_write_rows(
+            pool, scale, new[:, g], pids[:, g], offs[:, g], qmax
+        )
+    return pool, scale
 
 
-def block_bytes(cfg, block: int, dtype=None) -> int:
-    """HBM bytes one physical block costs (K + V across all layers)."""
+def quant_scatter_span(pool, scale, new, pids, offs, ub, qmax):
+    """Quantized prefill-span write into ONE layer's pool: position ``i``
+    of ``new [Hkv, W, hd]`` lands at ``(pids[i], offs[i])``. ``ub`` is the
+    touched-block id set (host-computed ``np.unique`` of ``pids``, padded
+    with scratch to a static width) — the requantization pass runs once
+    per touched block, not once per position. Scale updates use a
+    scatter-max so many positions landing in one block fold their amaxes
+    correctly in a single pass. Returns ``(pool, scale)``.
+
+    Vmapped over layers by the engine's scatter step (serve/engine.py):
+    the per-layer form keeps the gathered requant transient at one layer's
+    touched blocks."""
+    needed = jnp.max(jnp.abs(new.astype(jnp.float32)), axis=-1) / qmax
+    # needed [Hkv, W] -> per-block running max via scatter-max (dup-safe)
+    sc_new = scale.at[pids, :].max(needed.T)            # [P, Hkv]
+    old_ub = jnp.take(scale, ub, axis=0)                # [nU, Hkv]
+    new_ub = jnp.take(sc_new, ub, axis=0)
+    factor = jnp.where(new_ub > 0, old_ub / jnp.maximum(new_ub, 1e-30), 0.0)
+    blk = jnp.take(pool, ub, axis=0)                    # [nU, Hkv, blk, hd]
+    blk = _rescale_stored(blk, factor[..., None, None], qmax)
+    # duplicate ub entries are only the scratch padding — identical values
+    pool = pool.at[ub].set(blk)
+    sc_pos = jnp.take(sc_new, pids, axis=0)             # [W, Hkv]
+    row = quantize_values(
+        new.transpose(1, 0, 2), sc_pos[..., None], qmax, pool.dtype
+    )                                                   # [W, Hkv, hd]
+    return pool.at[pids, :, offs, :].set(row), sc_new
+
+
+def block_bytes(cfg, block: int, dtype=None, quant_kv: str = "") -> int:
+    """HBM bytes one physical block costs (K + V across all layers).
+    With ``quant_kv`` the payload is priced at the quantized dtype plus
+    the block's two scale rows (K and V, float32 per layer per head)."""
+    if quant_kv:
+        qdt, _ = kv_quant_spec(quant_kv)
+        payload = (2 * cfg.n_layers * cfg.n_kv_heads * block * cfg.head_dim
+                   * qdt.itemsize)
+        scales = 2 * cfg.n_layers * cfg.n_kv_heads * 4
+        return payload + scales
     dt = jnp.dtype(dtype or cfg.dtype)
     return 2 * cfg.n_layers * cfg.n_kv_heads * block * cfg.head_dim * dt.itemsize
 
@@ -226,13 +400,18 @@ class BlockPool:
 
 
 __all__ = [
+    "KV_QUANT_DTYPES",
     "SCRATCH_BLOCK",
     "BlockPool",
     "PagedKVCache",
     "block_bytes",
     "blocks_for",
     "create_cache",
+    "dequantize_values",
     "grow_cache",
+    "kv_quant_spec",
+    "quant_scatter_span",
+    "quantize_values",
     "scatter_block_kv",
     "shrink_cache",
 ]

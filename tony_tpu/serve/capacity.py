@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from tony_tpu.models.llama import LlamaConfig, init_params
 from tony_tpu.obs.compiles import aot_analysis
-from tony_tpu.serve.cache import PagedKVCache, blocks_for
+from tony_tpu.serve.cache import PagedKVCache, blocks_for, kv_quant_spec
 
 
 def _param_avals(cfg: LlamaConfig):
@@ -48,17 +48,28 @@ def _tree_bytes(tree) -> int:
 
 
 def _cache_avals(cfg: LlamaConfig, slots: int, capacity: int,
-                 kv_block: int) -> tuple[PagedKVCache, Any]:
+                 kv_block: int, quant_kv: str = "") -> tuple[PagedKVCache, Any]:
     """Paged pool + table avals sized so every slot reaches ``capacity``
     positions privately (scratch block included) — the worst case the
-    budget must cover; prefix sharing only ever reduces it."""
+    budget must cover; prefix sharing only ever reduces it. With
+    ``quant_kv`` the pools carry the quantized storage dtype plus the
+    per-block-per-head float32 scale pools, so the measured plan prices
+    exactly what the quantized engine allocates."""
     blocks = blocks_for(capacity, kv_block)
     n_phys = 1 + slots * blocks
     shape = (cfg.n_layers, n_phys, cfg.n_kv_heads, kv_block, cfg.head_dim)
+    pool_dtype = kv_quant_spec(quant_kv)[0] if quant_kv else cfg.dtype
+    scale = None
+    if quant_kv:
+        scale = jax.ShapeDtypeStruct(
+            (cfg.n_layers, n_phys, cfg.n_kv_heads), jnp.float32
+        )
     cache = PagedKVCache(
-        k=jax.ShapeDtypeStruct(shape, cfg.dtype),
-        v=jax.ShapeDtypeStruct(shape, cfg.dtype),
+        k=jax.ShapeDtypeStruct(shape, pool_dtype),
+        v=jax.ShapeDtypeStruct(shape, pool_dtype),
         lengths=jax.ShapeDtypeStruct((slots,), jnp.int32),
+        k_scale=scale,
+        v_scale=scale,
     )
     table = jax.ShapeDtypeStruct((slots, blocks), jnp.int32)
     return cache, table
@@ -84,14 +95,18 @@ def _state_avals(slots: int):
 
 def decode_step_analysis(cfg: LlamaConfig, *, slots: int, capacity: int,
                          kv_block: int = 64, decode_impl: str = "scan",
-                         max_top_k: int = 64) -> dict[str, Any]:
+                         max_top_k: int = 64,
+                         quant_kv: str = "") -> dict[str, Any]:
     """Compile (avals only — nothing allocated, nothing executed) the serve
-    engine's decode step and return its measured memory plan + FLOPs."""
+    engine's decode step and return its measured memory plan + FLOPs.
+    ``quant_kv`` compiles the quantized-cache variant of the step (scale
+    gathers + inline dequant included), so the plan is the quantized
+    engine's plan, not the bf16 plan with a smaller dtype penciled in."""
     from tony_tpu.serve.engine import _decode_fn
 
-    fn = _decode_fn(cfg, decode_impl, kv_block, max_top_k)
+    fn = _decode_fn(cfg, decode_impl, kv_block, max_top_k, False, quant_kv)
     params = _param_avals(cfg)
-    cache, table = _cache_avals(cfg, slots, capacity, kv_block)
+    cache, table = _cache_avals(cfg, slots, capacity, kv_block, quant_kv)
     compiled = fn.lower(
         params, cache, table, _state_avals(slots)
     ).compile()
@@ -100,13 +115,16 @@ def decode_step_analysis(cfg: LlamaConfig, *, slots: int, capacity: int,
     blocks = blocks_for(capacity, kv_block)
     from tony_tpu.serve.cache import block_bytes as _bb
 
+    pool_leaves = [cache.k, cache.v]
+    if cache.k_scale is not None:
+        pool_leaves += [cache.k_scale, cache.v_scale]
     return {
         "slots": slots,
         "capacity": capacity,
         "param_bytes": _tree_bytes(params),
-        "cache_bytes": _tree_bytes([cache.k, cache.v]),
+        "cache_bytes": _tree_bytes(pool_leaves),
         "table_bytes": _tree_bytes([table]),
-        "kv_bytes_per_slot": blocks * _bb(cfg, kv_block),
+        "kv_bytes_per_slot": blocks * _bb(cfg, kv_block, quant_kv=quant_kv),
         **aot_analysis(compiled),
     }
 
@@ -114,7 +132,8 @@ def decode_step_analysis(cfg: LlamaConfig, *, slots: int, capacity: int,
 def derive_slot_budget(cfg: LlamaConfig, *, max_len: int,
                        hbm_bytes: int, kv_block: int = 64,
                        decode_impl: str = "scan",
-                       shared_prefix_tokens: int = 0) -> dict[str, Any]:
+                       shared_prefix_tokens: int = 0,
+                       quant_kv: str = "") -> dict[str, Any]:
     """Slot budget at ``max_len`` from the compiled decode step's
     memory_analysis (params + fixed/per-slot temp + code) instead of the
     old ``hbm * 0.92 - params`` guess. Returns the budget plus every
@@ -126,7 +145,14 @@ def derive_slot_budget(cfg: LlamaConfig, *, max_len: int,
     system/template prefix, the shared blocks are paid ONCE (one
     refcounted physical copy in the pool) and each slot privately holds
     only its unshared tail — the per-slot marginal KV cost drops by the
-    shared fraction and the slot budget rises accordingly."""
+    shared fraction and the slot budget rises accordingly.
+
+    ``quant_kv`` ('int8' | 'fp8_e4m3') additionally compiles the
+    QUANTIZED decode step at the same two slot counts and reports its
+    budget (``max_slots_quant``, ``quant_slot_ratio``) next to the bf16
+    number — the ROADMAP item 4 capacity gain, measured from the
+    quantized step's own memory plan (smaller pools, extra scale rows,
+    dequant scratch) rather than assumed from the dtype ratio."""
     capacity = blocks_for(max_len, kv_block) * kv_block
     one = decode_step_analysis(
         cfg, slots=1, capacity=capacity, kv_block=kv_block,
@@ -179,22 +205,74 @@ def derive_slot_budget(cfg: LlamaConfig, *, max_len: int,
     if shared_prefix_tokens > 0:
         # shared-block accounting: the prefix's blocks exist once in the
         # pool (refcounted), each slot pays only its unshared tail
-        total_blocks = blocks_for(max_len, kv_block)
-        shared_blocks = min(shared_prefix_tokens // kv_block, total_blocks)
-        per_block = per_slot_kv // total_blocks
-        shared_bytes = shared_blocks * per_block
-        per_slot_private = per_slot_kv - shared_bytes
-        budget_shared = budget - shared_bytes
-        slots_shared = (
-            max(budget_shared // (per_slot_private + per_slot_temp), 0)
-            if budget_shared > 0 and (per_slot_private + per_slot_temp) > 0
-            else 0
+        shared_bytes, per_slot_private, slots_shared = _shared_budget(
+            per_slot_kv, per_slot_temp, budget,
+            shared_prefix_tokens, max_len, kv_block,
         )
         out["shared_prefix_tokens"] = int(shared_prefix_tokens)
         out["shared_prefix_bytes"] = int(shared_bytes)
         out["kv_bytes_per_slot_prefix_shared"] = int(per_slot_private)
         out["max_slots_prefix_shared"] = int(slots_shared)
+    if quant_kv:
+        q1 = decode_step_analysis(
+            cfg, slots=1, capacity=capacity, kv_block=kv_block,
+            decode_impl=decode_impl, quant_kv=quant_kv,
+        )
+        q2 = decode_step_analysis(
+            cfg, slots=2, capacity=capacity, kv_block=kv_block,
+            decode_impl=decode_impl, quant_kv=quant_kv,
+        )
+        qtemp1 = int(q1.get("temp_bytes", 0))
+        qtemp2 = int(q2.get("temp_bytes", qtemp1))
+        q_slot_temp = max(qtemp2 - qtemp1, 0)
+        q_fixed = max(qtemp1 - q_slot_temp, 0)
+        q_code = int(q1.get("generated_code_bytes", 0))
+        per_slot_kv_q = q1["kv_bytes_per_slot"]
+        budget_q = hbm_bytes - q1["param_bytes"] - q_fixed - q_code
+        quant = (
+            max(budget_q // (per_slot_kv_q + q_slot_temp), 0)
+            if budget_q > 0 else 0
+        )
+        out["quant_kv"] = quant_kv
+        out["fixed_temp_bytes_quant"] = int(q_fixed)
+        out["per_slot_temp_bytes_quant"] = int(q_slot_temp)
+        out["kv_bytes_per_slot_quant"] = int(per_slot_kv_q)
+        out["max_slots_quant"] = int(quant)
+        out["quant_slot_ratio"] = (
+            round(quant / native, 3) if native else 0.0
+        )
+        if shared_prefix_tokens > 0:
+            # shared blocks priced at QUANTIZED bytes: a refcounted
+            # prefix block in a quantized pool carries the int8/fp8
+            # payload plus its scale rows, nothing more
+            q_shared, q_private, q_slots_shared = _shared_budget(
+                per_slot_kv_q, q_slot_temp, budget_q,
+                shared_prefix_tokens, max_len, kv_block,
+            )
+            out["shared_prefix_bytes_quant"] = int(q_shared)
+            out["kv_bytes_per_slot_quant_prefix_shared"] = int(q_private)
+            out["max_slots_quant_prefix_shared"] = int(q_slots_shared)
     return out
+
+
+def _shared_budget(per_slot_kv: int, per_slot_temp: int, budget: int,
+                   shared_prefix_tokens: int, max_len: int,
+                   kv_block: int) -> tuple[int, int, int]:
+    """(shared bytes paid once, per-slot private KV bytes, slot budget)
+    under prefix sharing — the common math for the bf16 and quantized
+    variants, each feeding its own per-slot KV price."""
+    total_blocks = blocks_for(max_len, kv_block)
+    shared_blocks = min(shared_prefix_tokens // kv_block, total_blocks)
+    per_block = per_slot_kv // total_blocks
+    shared_bytes = shared_blocks * per_block
+    per_slot_private = per_slot_kv - shared_bytes
+    budget_shared = budget - shared_bytes
+    slots_shared = (
+        max(budget_shared // (per_slot_private + per_slot_temp), 0)
+        if budget_shared > 0 and (per_slot_private + per_slot_temp) > 0
+        else 0
+    )
+    return shared_bytes, per_slot_private, slots_shared
 
 
 __all__ = ["decode_step_analysis", "derive_slot_budget"]

@@ -67,9 +67,11 @@ from tony_tpu.obs import compiles as compile_ledger
 from tony_tpu.obs.metrics import DecodeMetrics
 from tony_tpu.obs.registry import HistogramWindow, Registry, snapshot_to_app_dir
 from tony_tpu.ops.decode_attention import decode_attention
+from tony_tpu.ops.quant_mm import quant_matmul, quantize_weights
 from tony_tpu.serve.cache import (
     SCRATCH_BLOCK, BlockPool, PagedKVCache, block_bytes, blocks_for,
-    create_cache, grow_cache, scatter_block_kv, shrink_cache,
+    create_cache, dequantize_values, grow_cache, kv_quant_spec,
+    quant_scatter_span, scatter_block_kv, shrink_cache,
 )
 from tony_tpu.serve.prefix import MatchResult, PrefixStore
 from tony_tpu.serve.spec import (
@@ -133,6 +135,18 @@ class ServeConfig:
     spec_max_draft: int = 4
     # 'auto' (store first, n-gram fallback) | 'prefix' | 'ngram'
     spec_draft_source: str = "auto"
+    # quantized KV cache (serve/cache.py "Quantized pools"): '' = bf16
+    # pools (off), 'int8' | 'fp8_e4m3' = block-scaled quantized pools —
+    # writes quantize against a running per-block-per-head scale, both
+    # decode kernels dequantize inline, and the slot budget roughly
+    # doubles (serve/capacity.py max_slots_quant measures it).
+    quant_kv: str = ""
+    # int8 weight-only decode matmuls (ops/quant_mm.py): the engine keeps
+    # the bf16 master params for prefill and decodes through a quantized
+    # copy with per-output-channel scales. Only meaningful with decode
+    # traffic; requires quant_kv unset or set independently (orthogonal
+    # knobs under one serve.quant.* config group).
+    quant_weights: bool = False
 
 
 class AdmissionRejected(RuntimeError):
@@ -258,6 +272,8 @@ class Engine:
             )
         if serve.spec and serve.spec_max_draft < 1:
             raise ValueError("spec_max_draft must be >= 1 with spec on")
+        if serve.quant_kv:
+            kv_quant_spec(serve.quant_kv)  # validate the knob at build time
         self.serve = ServeConfig(
             slots=serve.slots, max_len=max_len, kv_block=serve.kv_block,
             prefill_buckets=buckets, decode_impl=serve.decode_impl,
@@ -266,6 +282,7 @@ class Engine:
             prefix_budget_mb=serve.prefix_budget_mb, spec=serve.spec,
             spec_max_draft=serve.spec_max_draft,
             spec_draft_source=serve.spec_draft_source,
+            quant_kv=serve.quant_kv, quant_weights=serve.quant_weights,
         )
         S = self.serve.slots
         try:
@@ -281,7 +298,9 @@ class Engine:
         # the cached device copy
         B = self.serve.kv_block
         self._m_total = blocks_for(max_len, B)
-        blk_bytes = block_bytes(cfg, B)
+        blk_bytes = block_bytes(cfg, B, quant_kv=self.serve.quant_kv)
+        self._blk_bytes = blk_bytes
+        self.metrics.kv_bytes_per_token = blk_bytes / B
         budget_bytes = int(self.serve.prefix_budget_mb * 2**20)
         budget_blocks = (
             max(1, -(-budget_bytes // blk_bytes)) if budget_bytes
@@ -296,7 +315,18 @@ class Engine:
         p0 = max(2, min(1 + S, self._pool_cap))
         self._p0 = p0
         self._pool = BlockPool(p0)
-        self.cache = create_cache(cfg, S, p0, B)
+        self.cache = create_cache(cfg, S, p0, B, quant_kv=self.serve.quant_kv)
+        # quantized pools: block ids whose scale rows need zeroing before
+        # the next device write (allocation-time stale-scale reset — a
+        # reused block must not inherit its previous tenant's scale)
+        self._fresh_scale: list[int] = []
+        # int8 weight-only decode: quantize ONCE at build; prefill keeps
+        # the bf16 master params, decode/spec steps read the quantized copy
+        self._qparams = (
+            _quantize_decode_params(params) if self.serve.quant_weights
+            else None
+        )
+        self._dec_params = self._qparams if self._qparams is not None else params
         self._store: PrefixStore | None = None
         if self.serve.prefix:
             self._store = PrefixStore(
@@ -483,7 +513,14 @@ class Engine:
             # decode tokens emitted per decode step: 1.0 autoregressive,
             # > 1 when speculative drafts land (`tony top`'s tok/st)
             "tokens_per_step": round(self.metrics.tokens_per_step, 4),
+            # HBM per cached token (block bytes / block positions): the
+            # quantized-serving capacity win, live (`tony top`'s kvB/t)
+            "kv_bytes_per_token": round(self.metrics.kv_bytes_per_token, 2),
         }
+        if self.serve.quant_kv:
+            resident = float(self._pool.n_blocks * self._blk_bytes)
+            snap["quant_pool_resident_bytes"] = resident
+            self._g_quant_resident.set(resident)
         if self.serve.spec:
             snap["draft_accept_rate"] = round(
                 self.metrics.draft_accept_rate, 4
@@ -563,6 +600,15 @@ class Engine:
             "tony_serve_draft_accepted_total",
             "speculative draft tokens accepted (target sample agreed)",
         )
+        self._g_kv_bpt = reg.gauge(
+            "tony_serve_kv_bytes_per_token",
+            "HBM per cached token (quantized pools store int8/fp8 + scales)",
+        )
+        self._g_kv_bpt.set(self._blk_bytes / self.serve.kv_block)
+        self._g_quant_resident = reg.gauge(
+            "tony_serve_quant_pool_resident_bytes",
+            "HBM resident in the quantized KV pool (payload + scale rows)",
+        )
 
     def reset_metrics(self) -> None:
         """Fresh throughput/latency counters (e.g. after a warmup trace
@@ -574,6 +620,7 @@ class Engine:
             n_chips=self.metrics.n_chips,
             prefill_compiles=len(self._prefill_fns) + len(self._tail_fns),
             decode_compiles=len(self._decode_fns) + len(self._spec_fns),
+            kv_bytes_per_token=self.metrics.kv_bytes_per_token,
         )
         self._init_registry()
         # windowed-snapshot baselines re-base with the counters: a stale
@@ -901,6 +948,11 @@ class Engine:
                     "pool cap — engine accounting bug)"
                 )
             pid = self._pool.alloc()
+        if self.cache.quantized:
+            # a reused block carries its previous tenant's scale row —
+            # queue it for the batched zeroing flush (scale 0 = nothing
+            # real stored, so the first write fully defines the scale)
+            self._fresh_scale.append(pid)
         return pid
 
     def _plan_blocks(self, slot: int, plen: int, match: MatchResult | None) -> None:
@@ -920,7 +972,11 @@ class Engine:
                 # COW: the unshared tail writes into this block — hand the
                 # slot a private copy of the shared source first
                 dst = self._alloc_block()
-                self.cache = _copy_block_fn()(
+                if self.cache.quantized:
+                    # the copy overwrites dst's scale row with src's — a
+                    # later zeroing flush would erase it
+                    self._fresh_scale.remove(dst)
+                self.cache = _copy_block_fn(self.cache.quantized)(
                     self.cache, jnp.int32(match.partial), jnp.int32(dst)
                 )
                 row[next_bi] = dst
@@ -945,10 +1001,26 @@ class Engine:
             return match
         return MatchResult(len(match.full) * B, match.full, None)
 
+    def _flush_fresh_scales(self) -> None:
+        """Zero the scale rows of freshly allocated blocks in one batched
+        device write (padded to a power-of-two id count with scratch so
+        the jitted zeroing keeps a bounded signature set)."""
+        if not self._fresh_scale:
+            return
+        pids = self._fresh_scale
+        self._fresh_scale = []
+        n = 1
+        while n < len(pids):
+            n *= 2
+        padded = np.full(n, SCRATCH_BLOCK, np.int32)
+        padded[:len(pids)] = pids
+        self.cache = _zero_scales_fn()(self.cache, jnp.asarray(padded))
+
     def _scatter_prompt(self, slot: int, pk, pv, start: int, plen: int) -> None:
         """Write prefilled K/V (``[L, Hkv, W, hd]``, positions ``start +
         i``) into the slot's blocks; padded rows beyond ``plen`` steer to
-        the scratch block."""
+        the scratch block. Quantized pools quantize the span in the same
+        fused step (per-touched-block running-scale update)."""
         B = self.serve.kv_block
         row = self._table[slot]
         W = pk.shape[2]
@@ -957,6 +1029,19 @@ class Engine:
         pids = np.where(valid, row[np.minimum(p // B, self._m_total - 1)],
                         SCRATCH_BLOCK).astype(np.int32)
         offs = np.where(valid, p % B, 0).astype(np.int32)
+        if self.cache.quantized:
+            self._flush_fresh_scales()
+            # touched-block set at a STATIC width (the span covers at most
+            # W//B + 1 blocks, plus scratch) so signatures stay per-bucket
+            nU = W // B + 2
+            ub = np.full(nU, SCRATCH_BLOCK, np.int32)
+            uniq = np.unique(pids)
+            ub[:len(uniq)] = uniq
+            self.cache = _scatter_fn(self.serve.quant_kv)(
+                self.cache, pk, pv, jnp.asarray(pids), jnp.asarray(offs),
+                jnp.asarray(ub), jnp.int32(slot), jnp.int32(plen),
+            )
+            return
         self.cache = _scatter_fn()(
             self.cache, pk, pv, jnp.asarray(pids), jnp.asarray(offs),
             jnp.int32(slot), jnp.int32(plen),
@@ -994,7 +1079,9 @@ class Engine:
         n_have = blocks_for(plen, B)
         gather = np.full(nC, SCRATCH_BLOCK, np.int32)
         gather[:min(n_have, nC)] = row[:min(n_have, nC)]
-        ctx_k, ctx_v = _gather_fn()(self.cache, jnp.asarray(gather))
+        ctx_k, ctx_v = _gather_fn(self.cache.quantized, self.cfg.dtype)(
+            self.cache, jnp.asarray(gather)
+        )
         tail = np.zeros((1, tb), np.int32)
         tail[0, :tail_len] = prompt[matched:]
         with self._ledger.label(f"serve.prefill_tail[{tb},{C}]"):
@@ -1093,9 +1180,10 @@ class Engine:
             # dict only counts the distinct signatures this engine entered.
             self._decode_fns[signature] = _aot_decode(
                 self.cfg, self.serve.decode_impl, self.serve.kv_block,
-                self.serve.max_top_k, self.params, self.cache,
+                self.serve.max_top_k, self._dec_params, self.cache,
                 self._table_dev, self.state, self._ledger,
-                monitors=self._monitors,
+                monitors=self._monitors, quant_kv=self.serve.quant_kv,
+                quant_weights=self.serve.quant_weights,
             )
             self.metrics.decode_compiles = (
                 len(self._decode_fns) + len(self._spec_fns)
@@ -1113,8 +1201,10 @@ class Engine:
             self._spec_fns[signature] = _aot_spec_decode(
                 self.cfg, self.serve.decode_impl, self.serve.kv_block,
                 self.serve.max_top_k, self.serve.spec_max_draft,
-                self.params, self.cache, self._table_dev, self.state,
+                self._dec_params, self.cache, self._table_dev, self.state,
                 self._ledger, monitors=self._monitors,
+                quant_kv=self.serve.quant_kv,
+                quant_weights=self.serve.quant_weights,
             )
             self.metrics.decode_compiles = (
                 len(self._decode_fns) + len(self._spec_fns)
@@ -1165,6 +1255,8 @@ class Engine:
                 self._slot_blocks[s] += 1
                 self._table_dirty = True
             need = max(need, last // B + 1)
+        if self.cache.quantized:
+            self._flush_fresh_scales()
         self._set_attended(need)
         tracer = trace.active_tracer()
         sp = trace.NOOP_SPAN
@@ -1176,8 +1268,8 @@ class Engine:
             if spec_step:
                 self.cache, self.state, toks, n_emit, hmon = \
                     self._get_spec_decode(sig)(
-                        self.params, self.cache, self._table_dev, self.state,
-                        jnp.asarray(drafts_np),
+                        self._dec_params, self.cache, self._table_dev,
+                        self.state, jnp.asarray(drafts_np),
                         jnp.asarray(np.asarray(dlens, np.int32)),
                     )
             else:
@@ -1185,7 +1277,7 @@ class Engine:
                 # only step compiled with spec off — same signatures as
                 # the pre-spec engine)
                 self.cache, self.state, toks, hmon = self._get_decode(sig)(
-                    self.params, self.cache, self._table_dev, self.state
+                    self._dec_params, self.cache, self._table_dev, self.state
                 )
             # EXPLICIT per-step sync: continuous batching needs the sampled
             # tokens + done flags on host to steer admission — this is the
@@ -1242,7 +1334,8 @@ class Engine:
             params, cache, table, state, cfg=self.cfg,
             decode_impl=self.serve.decode_impl,
             kv_block=self.serve.kv_block, max_top_k=self.serve.max_top_k,
-            monitors=self._monitors,
+            monitors=self._monitors, quant_kv=self.serve.quant_kv,
+            quant_weights=self.serve.quant_weights,
         )
 
 
@@ -1266,7 +1359,8 @@ def _tail_fn(cfg: LlamaConfig, tb: int, max_top_k: int):
 
 @functools.lru_cache(maxsize=512)
 def _decode_fn(cfg: LlamaConfig, decode_impl: str, kv_block: int,
-               max_top_k: int, monitors: bool = False):
+               max_top_k: int, monitors: bool = False, quant_kv: str = "",
+               quant_weights: bool = False):
     """Jitted decode step, cached per (model config, kernel knobs) — NOT
     per pool-size/table-width: jit itself caches per argument shape, so
     all engines with the same model reuse every compiled signature. The
@@ -1275,6 +1369,7 @@ def _decode_fn(cfg: LlamaConfig, decode_impl: str, kv_block: int,
         partial(
             _decode_step, cfg=cfg, decode_impl=decode_impl,
             kv_block=kv_block, max_top_k=max_top_k, monitors=monitors,
+            quant_kv=quant_kv, quant_weights=quant_weights,
         ),
         donate_argnums=(1, 3),
     )
@@ -1312,11 +1407,14 @@ def _aot_compile(fn, avals, key, name, ledger, cache=_aot_prefill_cache):
 
 def _aot_decode(cfg: LlamaConfig, decode_impl: str, kv_block: int,
                 max_top_k: int, params, cache, table, state, ledger, *,
-                monitors: bool = False):
-    fn = _decode_fn(cfg, decode_impl, kv_block, max_top_k, monitors)
+                monitors: bool = False, quant_kv: str = "",
+                quant_weights: bool = False):
+    fn = _decode_fn(cfg, decode_impl, kv_block, max_top_k, monitors,
+                    quant_kv, quant_weights)
     try:
         shard = jax.tree.leaves(params)[0].sharding
         key = (cfg, decode_impl, kv_block, max_top_k, monitors,
+               quant_kv, quant_weights,
                cache.k.shape, str(cache.k.dtype), table.shape,
                hash(shard), shard)
     except Exception:
@@ -1376,12 +1474,33 @@ def _aot_tail_prefill(cfg: LlamaConfig, tb: int, ctx: int, max_top_k: int,
     )
 
 
-@functools.lru_cache(maxsize=1)
-def _scatter_fn():
+@functools.lru_cache(maxsize=4)
+def _scatter_fn(quant_kv: str = ""):
     """Jitted position-wise KV scatter into the (DONATED) pool: position
     ``i`` of the prefilled span lands in physical block ``pids[i]`` at
     offset ``offs[i]``; masked rows steer to the scratch block. One
-    in-place scatter instead of two whole-cache copies per admission."""
+    in-place scatter instead of two whole-cache copies per admission.
+    The quantized form additionally takes the touched-block set ``ub``
+    and runs the per-block running-scale update + requantization
+    (serve/cache.py quant_scatter_span, vmapped over layers)."""
+    if quant_kv:
+        _, qmax = kv_quant_spec(quant_kv)
+        span = jax.vmap(
+            partial(quant_scatter_span, qmax=qmax),
+            in_axes=(0, 0, 0, None, None, None),
+        )
+
+        def insert_q(cache: PagedKVCache, pk, pv, pids, offs, ub, slot,
+                     plen):
+            k, ksc = span(cache.k, cache.k_scale, pk, pids, offs, ub)
+            v, vsc = span(cache.v, cache.v_scale, pv, pids, offs, ub)
+            lengths = lax.dynamic_update_slice(
+                cache.lengths, plen[None], (slot,)
+            )
+            return PagedKVCache(k, v, lengths, ksc, vsc)
+
+        return jax.jit(insert_q, donate_argnums=(0,))
+
     def insert(cache: PagedKVCache, pk, pv, pids, offs, slot, plen):
         # pk/pv [L, Hkv, W, hd]; advanced indices (pids axis 1, offs axis
         # 3) are non-adjacent, so the indexed result moves to the front:
@@ -1394,36 +1513,88 @@ def _scatter_fn():
     return jax.jit(insert, donate_argnums=(0,))
 
 
-@functools.lru_cache(maxsize=1)
-def _copy_block_fn():
+@functools.lru_cache(maxsize=2)
+def _copy_block_fn(quant: bool = False):
     """Jitted copy-on-write block copy (DONATED pool): duplicate one
     physical block (all layers, K and V) so a slot about to write into a
-    shared block writes into its private copy instead."""
+    shared block writes into its private copy instead. A quantized pool
+    copies the block's scale rows with it — the COW copy dequantizes to
+    exactly what the shared source did."""
     def cp(cache: PagedKVCache, src, dst):
         kb = lax.dynamic_slice_in_dim(cache.k, src, 1, axis=1)
         vb = lax.dynamic_slice_in_dim(cache.v, src, 1, axis=1)
         k = lax.dynamic_update_slice_in_dim(cache.k, kb, dst, axis=1)
         v = lax.dynamic_update_slice_in_dim(cache.v, vb, dst, axis=1)
+        if quant:
+            ksb = lax.dynamic_slice_in_dim(cache.k_scale, src, 1, axis=1)
+            vsb = lax.dynamic_slice_in_dim(cache.v_scale, src, 1, axis=1)
+            ksc = lax.dynamic_update_slice_in_dim(
+                cache.k_scale, ksb, dst, axis=1
+            )
+            vsc = lax.dynamic_update_slice_in_dim(
+                cache.v_scale, vsb, dst, axis=1
+            )
+            return PagedKVCache(k, v, cache.lengths, ksc, vsc)
         return PagedKVCache(k, v, cache.lengths)
 
     return jax.jit(cp, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=1)
-def _gather_fn():
+def _zero_scales_fn():
+    """Jitted batched scale-row reset (DONATED cache): freshly allocated
+    blocks' K and V scale rows go to zero across all layers — the
+    nothing-real-stored marker the first quantized write keys off."""
+    def zero(cache: PagedKVCache, pids):
+        return cache._replace(
+            k_scale=cache.k_scale.at[:, pids, :].set(0.0),
+            v_scale=cache.v_scale.at[:, pids, :].set(0.0),
+        )
+
+    return jax.jit(zero, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=4)
+def _gather_fn(quant: bool = False, out_dtype=None):
     """Jitted prefix gather: pool blocks ``pids`` -> one contiguous
     ``[L, 1, C, Hkv, hd]`` context cache for the tail prefill (read-only:
-    the pool is NOT donated — the slot keeps serving from it)."""
+    the pool is NOT donated — the slot keeps serving from it). Quantized
+    pools dequantize through the gathered blocks' scale rows into
+    ``out_dtype`` — the tail prefill attends real-valued context."""
     def gat(cache: PagedKVCache, pids):
-        def one(pool):
+        def one(pool, scale):
             g = jnp.take(pool, pids, axis=1)           # [L, nC, Hkv, blk, hd]
+            if quant:
+                sc = jnp.take(scale, pids, axis=1)     # [L, nC, Hkv]
+                g = dequantize_values(g, sc[..., None, None], out_dtype)
             L, nC, Hkv, blk, hd = g.shape
             return g.transpose(0, 1, 3, 2, 4).reshape(
                 L, nC * blk, Hkv, hd
             )[:, None]                                 # [L, 1, C, Hkv, hd]
-        return one(cache.k), one(cache.v)
+        return one(cache.k, cache.k_scale), one(cache.v, cache.v_scale)
 
     return jax.jit(gat)
+
+
+_QUANT_WEIGHT_NAMES = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def _quantize_decode_params(params: Params) -> dict:
+    """One-time int8 copy of the decode-path weights (ops/quant_mm.py):
+    every layer matmul and lm_head swap to ``<name>_q``/``<name>_s``
+    pairs; norms and the embedding stay real-valued. The bf16 master
+    params are untouched — prefill keeps using them."""
+    layers = dict(params["layers"])
+    for name in _QUANT_WEIGHT_NAMES:
+        q, s = quantize_weights(layers.pop(name))
+        layers[name + "_q"] = q
+        layers[name + "_s"] = s
+    out = {k: v for k, v in params.items() if k not in ("layers", "lm_head")}
+    q, s = quantize_weights(params["lm_head"])
+    out["layers"] = layers
+    out["lm_head_q"] = q
+    out["lm_head_s"] = s
+    return out
 
 
 def _prefill_step(params, prompt, last_index, temp, top_k, top_p, key, *,
@@ -1476,18 +1647,34 @@ def _tail_prefill_step(params, ctx_k, ctx_v, tail, start, last_index, temp,
     return tok, carry, tk.transpose(0, 2, 1, 3), tv.transpose(0, 2, 1, 3)
 
 
+def _q_mm(h, lp, name, quant_weights, impl):
+    """One decode matmul: the bf16 master weight, or its int8 copy through
+    the fused dequant-matmul (ops/quant_mm.py) when quantized."""
+    if quant_weights:
+        return quant_matmul(h, lp[name + "_q"], lp[name + "_s"], impl=impl)
+    return h @ lp[name]
+
+
 def _decode_step(params, cache: PagedKVCache, table, state: _SlotState, *,
                  cfg: LlamaConfig, decode_impl: str, kv_block: int,
-                 max_top_k: int, monitors: bool = False):
+                 max_top_k: int, monitors: bool = False, quant_kv: str = "",
+                 quant_weights: bool = False):
     """One token for every slot: write K/V at each row's position (into
     the physical block its table names — dead slots steer to the scratch
     block so a freed, possibly reallocated block can never be corrupted),
     attend over its written prefix through the table, sample with its own
     stream. ``monitors`` additionally returns the fused per-slot health
     monitors (logits nonfinite counts + sampling entropy, obs/health.py);
-    the dict is empty when disarmed so the signature stays stable."""
+    the dict is empty when disarmed so the signature stays stable.
+
+    ``quant_kv``: the pools are block-scaled quantized — writes fold into
+    the running block scale and the attention kernels dequantize inline
+    through the scale pools, which ride the layer scan next to their
+    payloads. ``quant_weights``: the seven layer matmuls + lm_head read
+    int8 weights through the fused dequant-matmul."""
     from tony_tpu.models.generate import sample_tokens
 
+    qmax = kv_quant_spec(quant_kv)[1] if quant_kv else 0.0
     S = state.last_tok.shape[0]
     hd, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     x = params["tok_emb"][state.last_tok]                  # [S, D]
@@ -1513,29 +1700,53 @@ def _decode_step(params, cache: PagedKVCache, table, state: _SlotState, *,
     )
 
     def block(x, layer):
-        lp, k_pool, v_pool = layer
+        if quant_kv:
+            lp, k_pool, v_pool, k_sc, v_sc = layer
+        else:
+            lp, k_pool, v_pool = layer
+            k_sc = v_sc = None
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = rope((h @ lp["wq"]).reshape(S, H, hd))
-        k_new = rope((h @ lp["wk"]).reshape(S, Hkv, hd))
-        v_new = (h @ lp["wv"]).reshape(S, Hkv, hd)
+        mm = partial(_q_mm, quant_weights=quant_weights, impl=decode_impl)
+        q = rope(mm(h, lp, "wq").reshape(S, H, hd))
+        k_new = rope(mm(h, lp, "wk").reshape(S, Hkv, hd))
+        v_new = mm(h, lp, "wv").reshape(S, Hkv, hd)
         # per-row scatter into the pool (advanced indices pid/off move the
-        # row dim to the front: the slice value is [S, Hkv, hd] directly)
-        k_pool = scatter_block_kv(k_pool, k_new, pid, off)
-        v_pool = scatter_block_kv(v_pool, v_new, pid, off)
+        # row dim to the front: the slice value is [S, Hkv, hd] directly);
+        # quantized pools fold the written amax into the block scale
+        if quant_kv:
+            k_pool, k_sc = scatter_block_kv(
+                k_pool, k_new, pid, off, scale=k_sc, qmax=qmax
+            )
+            v_pool, v_sc = scatter_block_kv(
+                v_pool, v_new, pid, off, scale=v_sc, qmax=qmax
+            )
+        else:
+            k_pool = scatter_block_kv(k_pool, k_new, pid, off)
+            v_pool = scatter_block_kv(v_pool, v_new, pid, off)
         attn = decode_attention(
             q, k_pool, v_pool, pos + 1, tables=table,
-            impl=decode_impl, block=kv_block,
+            impl=decode_impl, block=kv_block, k_scale=k_sc, v_scale=v_sc,
         )
-        x = x + attn.reshape(S, H * hd) @ lp["wo"]
+        x = x + mm(attn.reshape(S, H * hd), lp, "wo")
         h2 = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-        delta = (jax.nn.silu(h2 @ lp["w1"]) * (h2 @ lp["w3"])) @ lp["w2"]
-        return x + delta, (k_pool, v_pool)
+        delta = mm(jax.nn.silu(mm(h2, lp, "w1")) * mm(h2, lp, "w3"),
+                   lp, "w2")
+        pools = (k_pool, v_pool) if not quant_kv else (
+            k_pool, v_pool, k_sc, v_sc
+        )
+        return x + delta, pools
 
-    x, (new_k, new_v) = lax.scan(
-        block, x, (params["layers"], cache.k, cache.v)
-    )
+    xs = (params["layers"], cache.k, cache.v)
+    if quant_kv:
+        xs = xs + (cache.k_scale, cache.v_scale)
+    x, pools = lax.scan(block, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)   # [S, V]
+    if quant_weights:
+        logits = quant_matmul(
+            x, params["lm_head_q"], params["lm_head_s"], impl=decode_impl
+        ).astype(jnp.float32)                              # [S, V]
+    else:
+        logits = (x @ params["lm_head"]).astype(jnp.float32)   # [S, V]
 
     both = jax.vmap(jax.random.split)(state.rng)           # [S, 2, 2]
     nxt = sample_tokens(
@@ -1548,13 +1759,14 @@ def _decode_step(params, cache: PagedKVCache, table, state: _SlotState, *,
     lengths = cache.lengths + state.live.astype(jnp.int32)
     new_state = state._replace(last_tok=nxt, rng=both[:, 1], done=done)
     hmon = health.decode_monitors(logits) if monitors else {}
-    return PagedKVCache(new_k, new_v, lengths), new_state, nxt, hmon
+    return PagedKVCache(*pools[:2], lengths, *pools[2:]), new_state, nxt, hmon
 
 
 def _spec_decode_step(params, cache: PagedKVCache, table, state: _SlotState,
                       drafts, draft_len, *, cfg: LlamaConfig,
                       decode_impl: str, kv_block: int, max_top_k: int,
-                      draft_k: int, monitors: bool = False):
+                      draft_k: int, monitors: bool = False,
+                      quant_kv: str = "", quant_weights: bool = False):
     """The speculative verify step: feed every row G = draft_k + 1 tokens
     (its last sampled token + its k drafts, short drafts padded), write
     their K/V at positions pos..pos+k, attend all G query positions in
@@ -1564,7 +1776,13 @@ def _spec_decode_step(params, cache: PagedKVCache, table, state: _SlotState,
     free: ``lengths`` advance by exactly the emitted count, so rejected
     positions' K/V sit beyond every length mask and are overwritten by
     later steps; padding positions past a row's draft length steer to the
-    scratch block and never touch real storage at all."""
+    scratch block and never touch real storage at all.
+
+    Quantization (``quant_kv``/``quant_weights``) rides exactly as in
+    :func:`_decode_step`. Rejected draft positions' amaxes stay folded
+    into their blocks' running scales — scales only ever grow, so a
+    rollback never leaves a block whose payload overflows its scale."""
+    qmax = kv_quant_spec(quant_kv)[1] if quant_kv else 0.0
     S = state.last_tok.shape[0]
     G = draft_k + 1
     hd, H, Hkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
@@ -1600,29 +1818,52 @@ def _spec_decode_step(params, cache: PagedKVCache, table, state: _SlotState,
     off = jnp.where(write_ok, off, 0)
 
     def block(x, layer):
-        lp, k_pool, v_pool = layer
+        if quant_kv:
+            lp, k_pool, v_pool, k_sc, v_sc = layer
+        else:
+            lp, k_pool, v_pool = layer
+            k_sc = v_sc = None
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = rope((h @ lp["wq"]).reshape(S, G, H, hd))
-        k_new = rope((h @ lp["wk"]).reshape(S, G, Hkv, hd))
-        v_new = (h @ lp["wv"]).reshape(S, G, Hkv, hd)
-        k_pool = scatter_block_kv(k_pool, k_new, pid, off)
-        v_pool = scatter_block_kv(v_pool, v_new, pid, off)
+        mm = partial(_q_mm, quant_weights=quant_weights, impl=decode_impl)
+        q = rope(mm(h, lp, "wq").reshape(S, G, H, hd))
+        k_new = rope(mm(h, lp, "wk").reshape(S, G, Hkv, hd))
+        v_new = mm(h, lp, "wv").reshape(S, G, Hkv, hd)
+        if quant_kv:
+            k_pool, k_sc = scatter_block_kv(
+                k_pool, k_new, pid, off, scale=k_sc, qmax=qmax
+            )
+            v_pool, v_sc = scatter_block_kv(
+                v_pool, v_new, pid, off, scale=v_sc, qmax=qmax
+            )
+        else:
+            k_pool = scatter_block_kv(k_pool, k_new, pid, off)
+            v_pool = scatter_block_kv(v_pool, v_new, pid, off)
         # multi-query paged attention: query g of row s sees positions
         # < pos0[s] + g + 1 (lengths arg = pos0 + G, kernel offsets per g)
         attn = decode_attention(
             q, k_pool, v_pool, pos0 + G, tables=table,
-            impl=decode_impl, block=kv_block,
+            impl=decode_impl, block=kv_block, k_scale=k_sc, v_scale=v_sc,
         )
-        x = x + attn.reshape(S, G, H * hd) @ lp["wo"]
+        x = x + mm(attn.reshape(S, G, H * hd), lp, "wo")
         h2 = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-        delta = (jax.nn.silu(h2 @ lp["w1"]) * (h2 @ lp["w3"])) @ lp["w2"]
-        return x + delta, (k_pool, v_pool)
+        delta = mm(jax.nn.silu(mm(h2, lp, "w1")) * mm(h2, lp, "w3"),
+                   lp, "w2")
+        pools = (k_pool, v_pool) if not quant_kv else (
+            k_pool, v_pool, k_sc, v_sc
+        )
+        return x + delta, pools
 
-    x, (new_k, new_v) = lax.scan(
-        block, x, (params["layers"], cache.k, cache.v)
-    )
+    xs = (params["layers"], cache.k, cache.v)
+    if quant_kv:
+        xs = xs + (cache.k_scale, cache.v_scale)
+    x, pools = lax.scan(block, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)   # [S, G, V]
+    if quant_weights:
+        logits = quant_matmul(
+            x, params["lm_head_q"], params["lm_head_s"], impl=decode_impl
+        ).astype(jnp.float32)                              # [S, G, V]
+    else:
+        logits = (x @ params["lm_head"]).astype(jnp.float32)   # [S, G, V]
 
     toks, n_emit, _n_acc, last_tok, new_rng, done = verify_and_accept(
         logits, drafts, draft_len, state, max_top_k=max_top_k,
@@ -1645,19 +1886,24 @@ def _spec_decode_step(params, cache: PagedKVCache, table, state: _SlotState,
         hmon = health.decode_monitors(frontier)
     else:
         hmon = {}
-    return PagedKVCache(new_k, new_v, lengths), new_state, toks, n_emit, hmon
+    return (
+        PagedKVCache(*pools[:2], lengths, *pools[2:]),
+        new_state, toks, n_emit, hmon,
+    )
 
 
 @functools.lru_cache(maxsize=512)
 def _spec_decode_fn(cfg: LlamaConfig, decode_impl: str, kv_block: int,
-                    max_top_k: int, draft_k: int, monitors: bool = False):
+                    max_top_k: int, draft_k: int, monitors: bool = False,
+                    quant_kv: str = "", quant_weights: bool = False):
     """Jitted speculative verify step — same cache discipline as
     :func:`_decode_fn` (per model/kernel knobs, table not donated)."""
     return jax.jit(
         partial(
             _spec_decode_step, cfg=cfg, decode_impl=decode_impl,
             kv_block=kv_block, max_top_k=max_top_k, draft_k=draft_k,
-            monitors=monitors,
+            monitors=monitors, quant_kv=quant_kv,
+            quant_weights=quant_weights,
         ),
         donate_argnums=(1, 3),
     )
@@ -1665,14 +1911,16 @@ def _spec_decode_fn(cfg: LlamaConfig, decode_impl: str, kv_block: int,
 
 def _aot_spec_decode(cfg: LlamaConfig, decode_impl: str, kv_block: int,
                      max_top_k: int, draft_k: int, params, cache, table,
-                     state, ledger, *, monitors: bool = False):
+                     state, ledger, *, monitors: bool = False,
+                     quant_kv: str = "", quant_weights: bool = False):
     fn = _spec_decode_fn(cfg, decode_impl, kv_block, max_top_k, draft_k,
-                         monitors)
+                         monitors, quant_kv, quant_weights)
     S = state.last_tok.shape[0]
     try:
         shard = jax.tree.leaves(params)[0].sharding
         key = ("spec", cfg, decode_impl, kv_block, max_top_k, draft_k,
-               monitors, cache.k.shape, str(cache.k.dtype), table.shape,
+               monitors, quant_kv, quant_weights,
+               cache.k.shape, str(cache.k.dtype), table.shape,
                hash(shard), shard)
     except Exception:
         return fn

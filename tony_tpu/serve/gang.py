@@ -87,6 +87,11 @@ class GangSettings:
     spec: bool = False
     spec_max_draft: int = 4
     spec_draft_source: str = "auto"
+    # quantized serving (serve.quant.* keys): block-scaled KV cache and
+    # optionally int8 weight-only decode matmuls
+    quant: bool = False
+    quant_kv_dtype: str = "int8"
+    quant_weights: bool = False
 
     @classmethod
     def from_config(cls, config: TonyConfig) -> "GangSettings":
@@ -127,6 +132,11 @@ class GangSettings:
             spec_draft_source=config.get_str(
                 Keys.SERVE_SPEC_DRAFT_SOURCE, "auto"
             ),
+            quant=config.get_bool(Keys.SERVE_QUANT_ENABLED, False),
+            quant_kv_dtype=config.get_str(
+                Keys.SERVE_QUANT_KV_DTYPE, "int8"
+            ),
+            quant_weights=config.get_bool(Keys.SERVE_QUANT_WEIGHTS, False),
         )
 
     def to_json(self) -> str:
@@ -172,6 +182,8 @@ def build_gang_engine(settings: GangSettings) -> "Engine":
             prefix_budget_mb=settings.prefix_budget_mb,
             spec=settings.spec, spec_max_draft=settings.spec_max_draft,
             spec_draft_source=settings.spec_draft_source,
+            quant_kv=settings.quant_kv_dtype if settings.quant else "",
+            quant_weights=settings.quant and settings.quant_weights,
         ),
     )
 
