@@ -1090,6 +1090,135 @@ def anatomy_bench(steps: int = 6) -> dict:
     return out
 
 
+def collective_overlap_bench(cfg=None, batch: int = 8, seq: int = 64,
+                             steps: int = 6, impl: str = "scan") -> dict:
+    """The overlap section: one sharded train step captured through the
+    real ProfileController path twice — decomposed fsdp collectives +
+    bucketed dp grad reduce OFF (GSPMD's blocking weight gathers, single
+    fused grad all-reduce) vs ON (ops/overlap ppermute rings +
+    bucketed_psum) — so the judged numbers (exposed_collective_ms
+    lower-better, overlap_frac higher-better, their off→on ratios) come
+    from the same capture/report path `tony profile` uses. The ON run's
+    grad-bucket budget is solved from the OFF capture's measured bandwidth
+    (bucket_bytes_from_report): the anatomy report drives the knob the
+    report then judges — the loop this PR closes."""
+    import dataclasses
+    import glob as _glob
+    import tempfile
+
+    from tony_tpu.models.llama import LlamaConfig
+    from tony_tpu.obs import anatomy, comms
+    from tony_tpu.obs import profile as profile_mod
+    from tony_tpu.ops.overlap import bucket_bytes_from_report
+    from tony_tpu.parallel.mesh import MeshShape, build_mesh, set_default_mesh
+    from tony_tpu.train.trainer import (
+        default_optimizer, make_train_state, make_train_step,
+    )
+
+    n = len(jax.devices())
+    if n < 2:
+        return {"error": "collective overlap bench needs >= 2 devices"}
+    if cfg is None:
+        cfg = LlamaConfig.tiny()
+    # fsdp ring (weight gathers) + a dp pair (grad reduce) when devices allow:
+    # the two collectives the tentpole decomposes
+    dp = 2 if n >= 4 and n % 2 == 0 else 1
+    mesh = build_mesh(MeshShape(dp=dp, fsdp=n // dp))
+    set_default_mesh(mesh)
+    opt = default_optimizer(warmup_steps=2, decay_steps=100)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size
+    )
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    def capture(variant_cfg, bucket_bytes):
+        state = make_train_state(jax.random.key(0), variant_cfg, mesh, opt)
+        step = make_train_step(
+            variant_cfg, mesh, opt, grad_bucket_bytes=bucket_bytes
+        )
+        ledger_rows = []
+        try:
+            compiled = step.lower(state, inputs, targets).compile()
+            ledger_rows = comms.extract_collectives(compiled)
+            step = compiled
+        except Exception:
+            pass  # lazy jit fallback: ledger-less capture still reports
+        out_root = tempfile.mkdtemp(prefix="tony-overlap-")
+        ctl = profile_mod.ProfileController(out_root, "bench", watch=False)
+        state, m = step(state, inputs, targets)  # warm outside the window
+        _fence(m["loss"])
+        ctl.trigger(steps=steps)
+        for _ in range(steps + 1):
+            ctl.step(fetch_s=0.0)
+            state, m = step(state, inputs, targets)
+            _fence(m["loss"])
+        ctl.finish()
+        mpaths = _glob.glob(
+            os.path.join(out_root, "bench", "*", "manifest.json")
+        )
+        if not mpaths:
+            return {"error": "no capture manifest landed"}
+        with open(mpaths[-1]) as fh:
+            manifest = json.load(fh)
+        rep = anatomy.proc_report(manifest, ledger_rows)
+        sec = {
+            "step_ms": rep["per_step_ms"]["step_time_s"],
+            "compute_ms": rep["per_step_ms"]["compute_s"],
+            "exposed_collective_ms": rep["per_step_ms"]["exposed_collective_s"],
+            "loss": round(float(m["loss"]), 4),
+        }
+        for k in ("overlap_frac", "pure_comm_steps"):
+            if k in rep:
+                sec[k] = rep[k]
+        top = next(
+            (r for r in rep["collectives"]
+             if r.get("bytes") and r.get("total_s")),
+            None,
+        )
+        if top is not None:
+            sec["top_collective"] = {
+                "kind": top["kind"], "bytes": top["bytes"],
+            }
+            if "achieved_gbps" in top:
+                sec["top_collective"]["achieved_gbps"] = top["achieved_gbps"]
+        return sec
+
+    off = capture(dataclasses.replace(cfg, overlap_impl=""), None)
+    if "error" in off:
+        return off
+    bucket_bytes = bucket_bytes_from_report(off, n_layers=cfg.n_layers)
+    on = capture(
+        dataclasses.replace(cfg, overlap_impl=impl),
+        bucket_bytes if dp > 1 else None,
+    )
+    out = {
+        "devices": n,
+        "mesh": {"dp": dp, "fsdp": n // dp},
+        "impl": impl,
+        "grad_bucket_bytes": bucket_bytes,
+        "off": off,
+        "on": on,
+    }
+    if "error" not in on:
+        # lift the judged keys to the section top so perf_diff's dotted
+        # rules (extra.overlap.*) see them without digging into variants
+        if "overlap_frac" in on:
+            out["overlap_frac"] = on["overlap_frac"]
+        out["exposed_collective_ms"] = on["exposed_collective_ms"]
+        if off.get("exposed_collective_ms"):
+            out["exposed_ratio"] = round(
+                on["exposed_collective_ms"] / off["exposed_collective_ms"], 4
+            )
+        if off.get("step_ms"):
+            out["step_ms_ratio"] = round(on["step_ms"] / off["step_ms"], 4)
+        # the value-safety receipt: both variants trained on the same
+        # batch/state — the decomposition is an execution schedule, not a
+        # different model
+        if "loss" in off and "loss" in on:
+            out["loss_delta"] = round(abs(on["loss"] - off["loss"]), 6)
+    return out
+
+
 def elastic_bench(steps: int = 18, members: int = 2) -> dict:
     """Kill-one-member mid-run (tony_tpu/elastic/, docs/ELASTIC.md): an
     elastic fit over ``members`` device groups shrinks at steps/3 (one
@@ -1213,6 +1342,9 @@ def run_bench() -> dict:
             "health_overhead", health_overhead_bench
         )
         extra["step_anatomy"] = _phased("step_anatomy", anatomy_bench)
+        extra["overlap"] = _phased(
+            "overlap", lambda: collective_overlap_bench(cfg, batch=8, seq=64)
+        )
         extra["elastic"] = _phased("elastic", elastic_bench)
         return {
             "metric": "llama_tiny_cpu_tokens_per_sec",
@@ -1290,6 +1422,11 @@ def run_bench() -> dict:
     extra["gqa_capacity"] = _phased("gqa_capacity", gqa_capacity_demo)
     extra["health_overhead"] = _phased("health_overhead", health_overhead_bench)
     extra["step_anatomy"] = _phased("step_anatomy", anatomy_bench)
+    # decomposed collectives + bucketed grad reduce, off vs on, through the
+    # real capture path ('pallas' = the TPU per-chunk kernel form)
+    extra["overlap"] = _phased("overlap", lambda: collective_overlap_bench(
+        cfg, batch=main["batch"], seq=2048, steps=6, impl="pallas"
+    ))
     extra["elastic"] = _phased("elastic", elastic_bench)
     extra["pipeline"] = _phased("pipeline", pipeline_bench)
     extra["submit_to_first_step_s"] = _phased(

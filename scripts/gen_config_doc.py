@@ -166,6 +166,20 @@ def _fit_config_section() -> list[str]:
                         "tokens — docs/PERF.md \"Grouped MoE\"), gather / "
                         "einsum the fixed-capacity paths. Kernel choice: "
                         "`LlamaConfig.moe_gmm_impl` (scan \\| pallas)",
+        "overlap_impl": "comm/compute overlap override: empty keeps "
+                        "model.overlap_impl; scan / pallas stream the fsdp "
+                        "weight all-gathers through the decomposed "
+                        "ppermute-ring matmuls instead of blocking up "
+                        "front (`tony_tpu.ops.overlap` — docs/PERF.md "
+                        "\"Overlap (collectives)\")",
+        "grad_bucket_mb": "dp gradient-reduction bucket size in MiB (0 "
+                          "keeps GSPMD's single fused all-reduce); > 0 "
+                          "switches to the manual-dp bucketed path — one "
+                          "collective per ~bucket of grad leaves, each "
+                          "dispatching as its layers' backward completes. "
+                          "Size from the measured anatomy report: "
+                          "`ops.overlap.bucket_bytes_from_report`. Needs "
+                          "dp > 1, pp == 1",
         "moe_group_block": "grouped-GEMM row tile override (0 keeps "
                            "`model.moe_group_block`); each expert's ragged "
                            "token group pads up to a multiple of this",

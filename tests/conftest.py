@@ -38,3 +38,31 @@ def _reset_default_mesh():
     from tony_tpu.parallel.mesh import set_default_mesh
 
     set_default_mesh(None)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """scripts/lint.py-style budget line: tier-1 runs close to its 870s
+    timeout, so every run prints the top-10 slowest tests — future PRs see
+    where the wall clock goes BEFORE they blow the budget (the cheap fix
+    is usually a slow-mark on a redundant engine build, the PR 14/17
+    pattern)."""
+    durations = []
+    for reports in terminalreporter.stats.values():
+        for rep in reports:
+            if getattr(rep, "when", "") == "call" and hasattr(rep, "duration"):
+                durations.append((rep.duration, rep.nodeid))
+    if not durations:
+        return
+    durations.sort(reverse=True)
+    total = sum(d for d, _ in durations)
+    top = durations[:10]
+    terminalreporter.write_sep(
+        "-", f"tier-1 wall clock: {total:.1f}s in test calls; top 10"
+    )
+    for dur, nodeid in top:
+        terminalreporter.write_line(f"  {dur:7.2f}s  {nodeid}")
+    terminalreporter.write_line(
+        f"  ({sum(d for d, _ in top):.1f}s = "
+        f"{100.0 * sum(d for d, _ in top) / total:.0f}% of the call total; "
+        "budget 870s — slow-mark redundant heavy tests, don't delete them)"
+    )

@@ -154,6 +154,36 @@ class TestBudgetRule:
         # overlap: 1ms of the 3ms collective hidden under compute
         assert b["overlap_frac"] == pytest.approx(1 / 3, abs=1e-3)
 
+    def test_pure_comm_steps_flagged_and_excluded_from_overlap(self):
+        """A step with collective time but ZERO compute (a standalone
+        reduce, a warmup gather) is flagged pure_comm and kept out of the
+        aggregate overlap_frac: there was no compute to hide under, so
+        counting its 100%-exposed collective would read as an overlap
+        collapse that no scheduling change can fix."""
+        manifest = {
+            "step_time_s": [0.010, 0.006], "input_wait_s": [0.002, 0.0],
+        }
+        trace_data = {
+            "found": True,
+            "step_windows": [(0.0, 0.010), (0.010, 0.016)],
+            "compute": [(0.000, 0.004)],  # none lands in step 2
+            "collective": [(0.003, 0.006), (0.011, 0.015)],
+            "collective_events": [
+                {"name": "all-reduce.1", "ts": 0.003, "dur_s": 0.003},
+                {"name": "all-gather.2", "ts": 0.011, "dur_s": 0.004},
+            ],
+        }
+        b = anatomy.step_budget(manifest, trace_data)
+        assert "pure_comm" not in b["table"][0]
+        assert b["table"][0]["compute_s"] == pytest.approx(0.004)
+        assert b["table"][1]["pure_comm"] is True
+        assert b["table"][1]["compute_s"] == pytest.approx(0.0)
+        assert b["table"][1]["exposed_collective_s"] == pytest.approx(0.004)
+        assert b["pure_comm_steps"] == 1
+        # step 1 alone: 1ms of its 3ms collective hidden (1/3) — step 2's
+        # fully exposed 4ms would have dragged this to 1/7 if counted
+        assert b["overlap_frac"] == pytest.approx(1 / 3, abs=1e-3)
+
     def test_no_device_trace_degrades_to_host_residual(self):
         manifest = {"step_time_s": [0.010, 0.008], "input_wait_s": [0.001, 0.0]}
         b = anatomy.step_budget(manifest, {"found": False})

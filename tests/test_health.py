@@ -423,6 +423,9 @@ class TestFitIntegration:
         verdicts = health.read_verdicts(str(tmp_path))
         assert verdicts["worker_0_user_a0"]["verdict"] == "healthy"
 
+    @pytest.mark.slow  # pays a full fit to assert an absent report key; the
+    # disarmed-compiles-nothing contract is covered by the serve-side
+    # disarmed test and install_from_env is asserted inline (870s budget)
     def test_health_disabled_by_env(self, monkeypatch):
         monkeypatch.setenv(health.ENV_ENABLED, "0")
         assert health.install_from_env() is None

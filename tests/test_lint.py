@@ -128,6 +128,53 @@ class TestGL002:
         """}, select="GL002")
         assert sorted(f.detail for f in fs) == ["jit-in-loop", "jit-of-lambda"]
 
+    def test_fires_on_shape_keyed_jit_of_partial_in_loop(self, tmp_path):
+        """The bucketed-collective regression shape (ops/overlap.py's
+        scheduler is exactly this): per step, per bucket, a fresh
+        `jit(partial(...))` — the partial is a new object every iteration
+        so the jit cache key never repeats and every bucket recompiles
+        every step."""
+        fs = lint_src(tmp_path, {"mod.py": """
+            import functools
+            import jax
+            from jax import lax
+
+            def reduce_buckets(buckets, axis):
+                out = []
+                for b in buckets:
+                    f = jax.jit(functools.partial(lax.psum, axis_name=axis))
+                    out.append(f(b))
+                return out
+        """}, select="GL002")
+        assert [f.detail for f in fs] == ["shape-keyed-jit-in-loop"]
+
+    def test_silent_on_hoisted_jit_of_partial(self, tmp_path):
+        """The FIX shapes must not fire: a jit-of-partial built once
+        outside the loop (the serve/engine.py AOT-family idiom) and
+        dispatched per bucket, or memoized per distinct static plan."""
+        fs = lint_src(tmp_path, {"mod.py": """
+            import functools
+            import jax
+            from jax import lax
+
+            def reduce_buckets(buckets, axis):
+                f = jax.jit(functools.partial(lax.psum, axis_name=axis))
+                return [f(b) for b in buckets]
+
+            def reduce_memoized(buckets, axis, cache):
+                out = []
+                for b in buckets:
+                    key = tuple(x.shape for x in b)
+                    if key not in cache:
+                        cache[key] = _build(axis)
+                    out.append(cache[key](b))
+                return out
+
+            def _build(axis):
+                return jax.jit(functools.partial(lax.psum, axis_name=axis))
+        """}, select="GL002")
+        assert fs == []
+
     def test_fires_on_branch_on_tracer(self, tmp_path):
         fs = lint_src(tmp_path, {"mod.py": """
             import jax

@@ -55,6 +55,23 @@ class TestFlattenAndRules:
         assert rule_for(
             "extra.step_anatomy.top_collective.bytes"
         )[0] == "config"
+        # decomposed-collective overlap (ops/overlap.py, bench `overlap`
+        # section): the on/off exposed and step-time ratios are
+        # lower-better (drifting toward 1.0 means the decomposition
+        # stopped paying); the grad-bucket budget is sized FROM the
+        # measured bandwidth, so it is configuration identity, never a
+        # memory metric; the within-run loss delta is a value-safety
+        # cross-check (~0), never judged relatively
+        assert rule_for("extra.overlap.overlap_frac")[0] == "higher"
+        assert rule_for("extra.overlap.exposed_collective_ms")[0] == "lower"
+        assert rule_for("extra.overlap.exposed_ratio")[0] == "lower"
+        assert rule_for("extra.overlap.step_ms_ratio")[0] == "lower"
+        assert rule_for("extra.overlap.grad_bucket_bytes")[0] == "config"
+        assert rule_for("extra.overlap.loss_delta")[0] == "skip"
+        assert rule_for("extra.overlap.on.pure_comm_steps")[0] == "skip"
+        assert rule_for(
+            "extra.overlap.on.top_collective.achieved_gbps"
+        )[0] == "higher"
         # prefix store (serve/prefix.py): hit rate is higher-better; the
         # on/off TTFT and prefill-FLOPs ratios are lower-better (a ratio
         # drifting toward 1.0 means the reuse stopped paying); residency
@@ -135,6 +152,13 @@ class TestVerdict:
         assert "extra.step_anatomy.overlap_frac" in keys
         assert "extra.step_anatomy.exposed_collective_ms" in keys
         assert "extra.step_anatomy.top_collective.achieved_gbps" in keys
+        # the overlap section gates too: a collapse of the decomposed
+        # rings (overlap_frac down, exposed time back up, the on/off
+        # ratios drifting past 1.0) all flag
+        assert "extra.overlap.overlap_frac" in keys
+        assert "extra.overlap.exposed_ratio" in keys
+        assert "extra.overlap.step_ms_ratio" in keys
+        assert "extra.overlap.on.exposed_collective_ms" in keys
         # the elastic section gates too: warm-restart cost (both the
         # journal number and the trace-goodput one) and the post-shrink
         # step-time ratio all flag

@@ -443,6 +443,10 @@ class TestQuantHealth:
         yield
         health.uninstall()
 
+    @pytest.mark.slow  # re-pays a full quantized-engine build: healthy
+    # decode through the quant path is already proven by the
+    # everything-live parity test, and sentinel trip/no-trip mechanics by
+    # the health suite (tier-1 runs close to its 870s timeout)
     def test_quantized_engine_trips_no_monitors(self, setup, tmp_path):
         """A healthy model served through the quantized path must not trip
         serve_nonfinite (dequant produces real values) or entropy_floor

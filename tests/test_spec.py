@@ -382,6 +382,9 @@ def test_engine_spec_matches_generate_greedy(setup):
         assert on[1][i] == on[0][i]
 
 
+@pytest.mark.slow  # re-pays a full spec-engine build for the sampled variant
+# of the greedy spec parity test above; the rejection rule's key-chain
+# behaviour is covered by the verify_and_accept unit family (tier-1 budget)
 def test_engine_spec_matches_generate_sampled(setup):
     """Same rng -> same tokens with speculation on: the rejection rule
     consumes the per-slot key chain exactly as the 1-wide step does, so
@@ -422,6 +425,9 @@ def test_engine_spec_matches_generate_sampled(setup):
         assert off[0][i] == list(np.asarray(solo[0, len(p):]))
 
 
+@pytest.mark.slow  # re-pays a full spec-engine build; eos-inside-span
+# truncation + done-row latching is covered by the verify_and_accept unit
+# family and greedy engine parity rides every decode (870s budget)
 def test_engine_spec_eos_inside_accepted_draft(setup):
     """An eos landing INSIDE an accepted multi-token span finishes the
     request at exactly the spec-off position — no overshoot tokens leak
@@ -466,6 +472,9 @@ def test_engine_spec_decode_impls_agree(setup):
 # --- compile ledger / metrics -------------------------------------------------
 
 
+@pytest.mark.slow  # 20 warm submissions through a full engine build; the
+# signature-family shape (every spec key mirrors a plain (blocks, attended)
+# key) is the cheap half and the ledger bound follows from it (870s budget)
 def test_spec_compile_count_is_bounded(setup):
     """Speculation adds at most a MIRROR of the plain decode signature
     family (one fixed G per engine) — never a per-draft-length or
@@ -487,6 +496,9 @@ def test_spec_compile_count_is_bounded(setup):
     assert all(len(sig) == 2 for sig in eng._spec_fns)
 
 
+@pytest.mark.slow  # re-pays a full spec-engine build to read gauge fields;
+# record_spec arithmetic is unit-covered and the counters ride every parity
+# test above (tier-1 runs close to its 870s timeout)
 def test_spec_metrics_and_snapshot(setup):
     cfg, params = setup
     eng = Engine(params, cfg, ServeConfig(

@@ -7,8 +7,10 @@ code     name                  what it catches
 GL001    host-sync-in-jit      ``.item()`` / ``float(tracer)`` / ``np.asarray``
                                / ``jax.device_get`` / ``print`` in functions
                                reachable from a jit entry point
-GL002    recompile-hazard      ``jax.jit`` in a loop, jit-of-lambda inside a
-                               function body, Python branch on a traced value,
+GL002    recompile-hazard      ``jax.jit`` in a loop, jit-of-partial in a
+                               loop (shape-keyed bucket dispatch re-jitting
+                               per step), jit-of-lambda inside a function
+                               body, Python branch on a traced value,
                                mutable default behind ``static_argnums``
 GL003    donation-reuse        reading an argument after passing it to a
                                ``donate_argnums`` jit in the same scope
@@ -265,13 +267,39 @@ class RecompileHazard:
                         if (loops and isinstance(child, ast.Call)
                                 and project.dotted_resolved(mi, child.func)
                                 in ("jax.jit", "jit", "pjit", "jax.pjit")):
-                            yield em.emit(
-                                mi.sf.path, child.lineno, fi.local,
-                                "`jax.jit` inside a loop builds a fresh "
-                                "jitted callable (and cache entry) every "
-                                "iteration — hoist it out of the loop",
-                                "jit-in-loop",
-                            )
+                            # refine the in-loop case: jit of a fresh
+                            # functools.partial is the bucketed-collective
+                            # regression shape — a per-step shape-keyed
+                            # dispatch that rebuilds the partial (and so
+                            # the jit cache key) every iteration, so every
+                            # bucket recompiles every step even when its
+                            # shapes repeat
+                            wrapped = child.args[0] if child.args else None
+                            if (isinstance(wrapped, ast.Call)
+                                    and project.dotted_resolved(
+                                        mi, wrapped.func)
+                                    in ("functools.partial", "partial")):
+                                yield em.emit(
+                                    mi.sf.path, child.lineno, fi.local,
+                                    "`jax.jit(partial(...))` inside a loop: "
+                                    "the partial is a fresh callable every "
+                                    "iteration so the jit cache never hits "
+                                    "— a shape-keyed bucket dispatch "
+                                    "re-jits every bucket every step. "
+                                    "Build the jitted callable once per "
+                                    "distinct plan (hoist it, or memoize "
+                                    "keyed by the static shapes) and "
+                                    "dispatch through it",
+                                    "shape-keyed-jit-in-loop",
+                                )
+                            else:
+                                yield em.emit(
+                                    mi.sf.path, child.lineno, fi.local,
+                                    "`jax.jit` inside a loop builds a fresh "
+                                    "jitted callable (and cache entry) every "
+                                    "iteration — hoist it out of the loop",
+                                    "jit-in-loop",
+                                )
                         if is_loop:
                             loops.append(child)
                         yield from visit(child)

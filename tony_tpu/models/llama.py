@@ -90,6 +90,13 @@ class LlamaConfig:
     # pallas CE kernel tile sizes (rows x vocab); clipped to B*S and V
     ce_block_n: int = 512
     ce_block_v: int = 512
+    # comm/compute overlap for the fsdp-sharded trunk matmuls
+    # (tony_tpu.ops.overlap): '' = GSPMD's blocking weight all-gathers
+    # (default); 'scan' = decomposed ppermute-ring all-gather-matmul,
+    # pure-XLA per-chunk inner; 'pallas' = same ring with the TPU tiled
+    # matmul kernel per chunk. Falls back to the plain matmul wherever the
+    # decomposition doesn't apply (no fsdp ring, manual region, odd shapes).
+    overlap_impl: str = ""
 
     @property
     def head_dim(self) -> int:
@@ -340,15 +347,37 @@ def _get_attention(cfg: LlamaConfig) -> AttnFn:
     raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
 
 
+def _proj(x: jax.Array, w: jax.Array, cfg: LlamaConfig,
+          axes: tuple[str | None, ...]) -> jax.Array:
+    """One trunk projection ``x [B,S,D] @ w``. With ``cfg.overlap_impl``
+    set, the fsdp weight all-gather streams per-chunk through the
+    decomposed ring matmul (tony_tpu.ops.overlap) instead of blocking up
+    front; ``axes`` are the weight's per-layer logical axes — which dim
+    rides the ring is read off the sharding rules (parallel.sharding), not
+    hardcoded here. Silently the plain matmul wherever the decomposition
+    doesn't apply: overlap is an optimisation, never a semantic.
+    """
+    if cfg.overlap_impl:
+        from tony_tpu.ops.overlap import overlap_matmul
+        from tony_tpu.parallel.sharding import overlap_gather_dim
+
+        gd = overlap_gather_dim(axes)
+        if gd is not None:
+            y = overlap_matmul(x, w, gather_dim=gd, impl=cfg.overlap_impl)
+            if y is not None:
+                return y
+    return x @ w
+
+
 def attention_block(x: jax.Array, lp: Params, cfg: LlamaConfig,
                     cos: jax.Array, sin: jax.Array) -> jax.Array:
     B, S, _ = x.shape
     hd = cfg.head_dim
     from jax.ad_checkpoint import checkpoint_name
 
-    q = (x @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
-    k = (x @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
-    v = (x @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = _proj(x, lp["wq"], cfg, ("embed", "heads")).reshape(B, S, cfg.n_heads, hd)
+    k = _proj(x, lp["wk"], cfg, ("embed", "kv_heads")).reshape(B, S, cfg.n_kv_heads, hd)
+    v = _proj(x, lp["wv"], cfg, ("embed", "kv_heads")).reshape(B, S, cfg.n_kv_heads, hd)
     q = checkpoint_name(apply_rope(q, cos, sin), "attn_qkv")
     k = checkpoint_name(apply_rope(k, cos, sin), "attn_qkv")
     v = checkpoint_name(v, "attn_qkv")
@@ -368,16 +397,22 @@ def attention_block(x: jax.Array, lp: Params, cfg: LlamaConfig,
     from jax.ad_checkpoint import checkpoint_name
 
     out = checkpoint_name(out, "attn_out")
-    return out.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
+    return _proj(
+        out.reshape(B, S, cfg.n_heads * hd), lp["wo"], cfg, ("heads", "embed")
+    )
 
 
-def ffn_block(x: jax.Array, lp: Params) -> jax.Array:
+def ffn_block(x: jax.Array, lp: Params, cfg: LlamaConfig) -> jax.Array:
     from jax.ad_checkpoint import checkpoint_name
 
     # named save point: remat policies can keep the gate product so the bwd
     # recompute skips the two widest matmuls (w1/w3, ~45% of a layer's fwd)
-    gate = checkpoint_name(jax.nn.silu(x @ lp["w1"]) * (x @ lp["w3"]), "ffn_gate")
-    return gate @ lp["w2"]
+    gate = checkpoint_name(
+        jax.nn.silu(_proj(x, lp["w1"], cfg, ("embed", "ffn")))
+        * _proj(x, lp["w3"], cfg, ("embed", "ffn")),
+        "ffn_gate",
+    )
+    return _proj(gate, lp["w2"], cfg, ("ffn", "embed"))
 
 
 def moe_ffn_block(x: jax.Array, lp: Params, cfg: LlamaConfig):
@@ -409,7 +444,7 @@ def transformer_block(x: jax.Array, lp: Params, cfg: LlamaConfig,
     if cfg.is_moe:
         delta, aux = moe_ffn_block(normed, lp, cfg)
     else:
-        delta, aux = ffn_block(normed, lp), jnp.zeros((), jnp.float32)
+        delta, aux = ffn_block(normed, lp, cfg), jnp.zeros((), jnp.float32)
     return h + delta, aux
 
 

@@ -201,6 +201,13 @@ def step_budget(manifest: dict[str, Any],
     rows: list[dict[str, Any]] = []
     tot = {"step_time_s": 0.0, "compute_s": 0.0, "exposed_collective_s": 0.0,
            "input_wait_s": 0.0, "host_blocked_s": 0.0, "collective_s": 0.0}
+    # overlap accumulators EXCLUDE pure-comm steps (collective time with
+    # zero compute in the window — a sync barrier, an init broadcast): such
+    # a step has no compute to hide under, so its collective time is 100%
+    # exposed by construction and would dilute overlap_frac — one barrier
+    # step could mask a real overlap regression in the training steps
+    ov_coll = ov_exposed = 0.0
+    pure_comm_steps = 0
     for i, step_time in enumerate(step_times):
         wait = waits[i] if i < len(waits) else 0.0
         compute_s = exposed_s = coll_s = 0.0
@@ -212,14 +219,22 @@ def step_budget(manifest: dict[str, Any],
             coll_s = _total(coll)
             exposed_s = _total(_subtract(coll, compute))
         host = max(step_time - compute_s - exposed_s - wait, 0.0)
-        rows.append({
+        pure_comm = coll_s > 0.0 and compute_s == 0.0
+        row = {
             "step": i + 1,
             "step_time_s": round(step_time, 6),
             "compute_s": round(compute_s, 6),
             "exposed_collective_s": round(exposed_s, 6),
             "input_wait_s": round(wait, 6),
             "host_blocked_s": round(host, 6),
-        })
+        }
+        if pure_comm:
+            row["pure_comm"] = True
+            pure_comm_steps += 1
+        else:
+            ov_coll += coll_s
+            ov_exposed += exposed_s
+        rows.append(row)
         tot["step_time_s"] += step_time
         tot["compute_s"] += compute_s
         tot["exposed_collective_s"] += exposed_s
@@ -238,12 +253,13 @@ def step_budget(manifest: dict[str, Any],
                       "input_wait_s", "host_blocked_s")
         },
     }
-    if tot["collective_s"] > 0:
+    if pure_comm_steps:
+        out["pure_comm_steps"] = pure_comm_steps
+    if ov_coll > 0:
         # fraction of collective time hidden under compute: the overlap
-        # number `tony perf diff` judges higher-is-better
-        out["overlap_frac"] = round(
-            1.0 - tot["exposed_collective_s"] / tot["collective_s"], 4
-        )
+        # number `tony perf diff` judges higher-is-better. Pure-comm steps
+        # are excluded (flagged per row) — they have nothing to overlap.
+        out["overlap_frac"] = round(1.0 - ov_exposed / ov_coll, 4)
     return out
 
 

@@ -47,6 +47,13 @@ DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
     # configuration identity (not performance)
     (r"(n_params|n_active_params|batch|seq|vocab|n_layers|n_heads|"
      r"capacity_factor|top_k|slots_formula|kv_block|window)", "config", 0.0),
+    # decomposed-collective overlap (ops/overlap.py, bench `overlap`
+    # section): the within-run |on - off| loss delta is a value-safety
+    # cross-check (≈0 by construction, asserted directly by tests), not
+    # a judged metric — it must outrank the loss rule below or a
+    # 1e-7 -> 2e-7 float jitter would flag as an infinite relative
+    # regression. Pure-comm step counts are trace-shaped.
+    (r"(loss_delta|pure_comm_steps)", "skip", 0.0),
     # quality: loss/perplexity may not silently regress either
     (r"(loss|perplexity)", "lower", 0.02),
     # elastic restart cost (tony_tpu/elastic/, bench `elastic` section):
@@ -76,6 +83,17 @@ DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
     (r"top_collective\.bytes", "config", 0.0),
     (r"(overlap_frac|achieved_gbps)", "higher", 0.05),
     (r"(exposed_collective)", "lower", 0.10),
+    # decomposed-collective overlap, bench `overlap` section
+    # (collective_overlap_bench): the on/off exposed-collective and
+    # step-time ratios are the overlap headline — lower is better, and
+    # `step_ms_ratio` carries no terminal latency token so it would
+    # otherwise go unjudged. The gradient-bucket budget is SIZED from
+    # the measured bandwidth (bucket_bytes_from_report): a changed
+    # budget means the measurement changed, not that memory regressed —
+    # like top_collective.bytes it is configuration identity and must
+    # outrank the memory catch-all below.
+    (r"(exposed_ratio|step_ms_ratio)", "lower", 0.10),
+    (r"grad_bucket_bytes", "config", 0.0),
     # prefix store (serve/prefix.py, bench `decode.prefix_trace`): hit
     # rate/tokens are higher-better; the TTFT and prefill-FLOPs on/off
     # ratios are the reuse headline — lower is better, and they must

@@ -61,6 +61,26 @@ def spec_for(logical_axes: tuple[str | None, ...], rules: Rules = DEFAULT_RULES)
     return P(*parts)
 
 
+def overlap_gather_dim(
+    logical_axes: tuple[str | None, ...],
+    rules: Rules = DEFAULT_RULES,
+    mesh_axis: str = "fsdp",
+) -> int | None:
+    """Which positional dim of a weight the rules shard over ``mesh_axis``
+    — the dim the decomposed all-gather-matmul ring rotates
+    (tony_tpu.ops.overlap). None when the weight carries no shard on that
+    axis (nothing to overlap) or more than one dim maps to it (the ring
+    decomposition assumes a single gathered dim).
+    """
+    dims = []
+    for i, name in enumerate(logical_axes):
+        axis = rules.get(name) if name is not None else None
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        if mesh_axis in axes:
+            dims.append(i)
+    return dims[0] if len(dims) == 1 else None
+
+
 def attn_spec(mesh: Mesh, seq_axis: str | None = None) -> P:
     """PartitionSpec for [B, S, H, head_dim] attention activations.
 

@@ -95,6 +95,18 @@ class FitConfig:
     # the dropless sorted grouped GEMM, 'gather'/'einsum' the capacity paths
     # (tony_tpu.parallel.moe — docs/PERF.md "Grouped MoE")
     moe_dispatch: str = ""
+    # comm/compute overlap override (tony_tpu.ops.overlap, docs/PERF.md
+    # "Overlap (collectives)"): '' keeps model.overlap_impl; 'scan'/'pallas'
+    # stream the fsdp weight all-gathers per-chunk through the decomposed
+    # ppermute-ring matmuls instead of blocking up front
+    overlap_impl: str = ""
+    # dp gradient-reduction bucket size in MiB (0 disables — GSPMD's single
+    # fused all-reduce): > 0 switches the step to the manual-dp bucketed
+    # path, one collective per ~bucket of grad leaves so each reduce
+    # dispatches as its layers' backward completes. Size it from the
+    # measured anatomy report: ops.overlap.bucket_bytes_from_report
+    # (achieved_gbps x per-layer backward window). Needs dp > 1, pp == 1.
+    grad_bucket_mb: float = 0.0
     # grouped-GEMM row tile override (0 keeps model.moe_group_block)
     moe_group_block: int = 0
     # elastic training (tony_tpu/elastic/, docs/ELASTIC.md): gang size at
@@ -311,6 +323,7 @@ class _Elastic:
                 cfg.model, self.mesh, optimizer, rules,
                 n_microbatches=cfg.pp_microbatches,
                 pp_schedule=cfg.pp_schedule,
+                grad_bucket_bytes=int(cfg.grad_bucket_mb * (1 << 20)),
             )
             batch_sharding = NamedSharding(
                 self.mesh, spec_for(("batch", "seq"), cfg.rules)
@@ -385,7 +398,7 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     # sweeps) must not inherit the first one's peak
     hbm_mark = hbm_watch.mark() if hbm_watch is not None else None
     cfg.apply_job_env()
-    if cfg.ce_impl or cfg.moe_dispatch or cfg.moe_group_block:
+    if cfg.ce_impl or cfg.moe_dispatch or cfg.moe_group_block or cfg.overlap_impl:
         from dataclasses import replace as _replace
 
         overrides = {}
@@ -395,6 +408,8 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             overrides["moe_dispatch"] = cfg.moe_dispatch
         if cfg.moe_group_block:
             overrides["moe_group_block"] = cfg.moe_group_block
+        if cfg.overlap_impl:
+            overrides["overlap_impl"] = cfg.overlap_impl
         cfg.model = _replace(cfg.model, **overrides)
     cache_dir = os.environ.get("TONY_JAX_CACHE_DIR", "")
     if cache_dir and cfg.elastic_members >= 2:
@@ -459,6 +474,7 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     step_fn = make_train_step(
         cfg.model, mesh, optimizer, rules,
         n_microbatches=cfg.pp_microbatches, pp_schedule=cfg.pp_schedule,
+        grad_bucket_bytes=int(cfg.grad_bucket_mb * (1 << 20)),
     )
 
     # --- compile-ahead: AOT-lower/compile the step on a worker thread while

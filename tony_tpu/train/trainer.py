@@ -146,7 +146,7 @@ def make_train_step(
     cfg: llama.LlamaConfig, mesh: Mesh,
     optimizer: optax.GradientTransformation, rules: Rules = DEFAULT_RULES,
     *, n_microbatches: int = 0, pp_schedule: str = "gpipe",
-    monitors: bool | None = None,
+    monitors: bool | None = None, grad_bucket_bytes: int | None = None,
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted train step:
     ``(state, inputs[B,S], targets[B,S]) -> (state, metrics)``.
@@ -169,6 +169,17 @@ def make_train_step(
     raise n_microbatches freely to shrink the bubble). The caller's rules
     must map "layers" to "pp" (fit() does this automatically;
     :func:`pp_rules` applies the override).
+
+    ``grad_bucket_bytes`` (> 0, dp > 1, pp == 1) switches the dp gradient
+    reduction from GSPMD's single fused all-reduce to the async bucketed
+    path: value_and_grad runs inside a shard_map manual over ``dp`` and the
+    grads all-reduce in byte-budgeted buckets (ops.overlap.bucketed_psum),
+    one collective per bucket in leaf order — each bucket's reduce
+    dispatches as soon as its leaves' backward is done and rides behind the
+    remaining backward compute. Size the budget off the measured anatomy
+    report (ops.overlap.bucket_bytes_from_report). Value-exact: bucketing
+    never changes the sums, so the loss trajectory is bitwise-identical to
+    the unbucketed (single-bucket) manual path.
     """
     _ensure_partitionable_threefry()
     if pp_schedule not in ("gpipe", "1f1b"):
@@ -201,6 +212,49 @@ def make_train_step(
         loss_fn = partial(
             llama.loss_from_pairs, cfg=cfg, act_sharding=act_sharding
         )
+    dp = int(mesh.shape.get("dp", 1))
+    if grad_bucket_bytes and dp > 1 and pp == 1:
+        # async bucketed dp grad reduce: manualize the dp axis so the
+        # reduction is OUR schedule (one psum per bucket, leaf order), not
+        # the partitioner's single fused all-reduce. The local loss is the
+        # mean over this shard's rows; psum/dp restores the global mean
+        # (equal shard sizes), and grads pre-scale by 1/dp so the bucketed
+        # psums land on the global-mean gradient directly.
+        from tony_tpu.ops.compat import axis_size as _axis_size
+        from tony_tpu.ops.overlap import bucketed_psum
+
+        # no activation pinning inside the manual region: the constraint
+        # names mesh axes the region has manualized (and there is no
+        # partitioner decision left to pin on this side of the boundary)
+        inner_loss = partial(llama.loss_from_pairs, cfg=cfg, act_sharding=None)
+
+        def _local_vg(params, inputs, targets):
+            loss, grads = jax.value_and_grad(inner_loss)(
+                params, inputs, targets
+            )
+            n = _axis_size("dp")
+            loss = jax.lax.psum(loss, "dp") / n
+            grads = jax.tree.map(lambda g: g / n, grads)
+            grads = bucketed_psum(
+                grads, "dp", bucket_bytes=int(grad_bucket_bytes)
+            )
+            return loss, grads
+
+        batch_spec = P("dp", None)  # [B, S] token pairs, rows over dp
+        bucketed_vg = _shard_map(
+            _local_vg, mesh=mesh,
+            in_specs=(P(), batch_spec, batch_spec),
+            out_specs=(P(), P()),
+            axis_names={"dp"},
+        )
+    else:
+        bucketed_vg = None
+
+    def value_and_grad_fn(params, inputs, targets):
+        if bucketed_vg is not None:  # build-time constant, not a tracer
+            return bucketed_vg(params, inputs, targets)
+        return jax.value_and_grad(loss_fn)(params, inputs, targets)
+
     shardings = state_shardings(cfg, mesh, optimizer, rules)
     batch_sharding = NamedSharding(mesh, spec_for(("batch", "seq"), rules))
     replicated = NamedSharding(mesh, P())
@@ -219,7 +273,7 @@ def make_train_step(
     nan_step = _health.nan_inject_step()
 
     def step(state: TrainState, inputs: jax.Array, targets: jax.Array):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, inputs, targets)
+        loss, grads = value_and_grad_fn(state.params, inputs, targets)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
