@@ -728,6 +728,144 @@ def decode_bench(on_tpu: bool) -> dict:
         )
     out["quant"] = quant_out
 
+    # (g) disaggregated prefill/decode (engine chunked prefill +
+    # serve/gang.py pool handoff): a mixed-arrival trace where one LONG
+    # prompt lands mid-stream among short decoders — the interference
+    # headline. Four modes, chunking off/on x colocated/pooled:
+    # colocated means one engine prefills AND decodes, so the long
+    # prefill stalls every live decoder for the whole prompt unless it
+    # is chunked (one chunk interleaved per decode step); pooled means a
+    # second engine plays the prefill host — it prefills the long
+    # prompt, exports the finished paged blocks, and the payload rides
+    # the real wire format (pack/unpack measured as handoff bytes/ms)
+    # into the decode engine, so decode-side admission prefix-hits the
+    # shipped blocks and the long prompt never runs on the decode mesh.
+    # TTFT/TPOT come from per-request completions polled step-by-step
+    # (the engine's windowed snapshot is the series recorder's single
+    # window — the bench must not consume it), and the warm passes pay
+    # BOTH the compiles and the KV-pool growth: the store retains warm
+    # blocks, the pool grows once, a drain frees everything, and the
+    # timed pass runs at the settled pool shape with zero recompiles.
+    from tony_tpu.serve.cache import pack_payload, unpack_payload
+
+    if on_tpu:
+        disagg_long, disagg_chunk = 512, 256
+    else:
+        disagg_long, disagg_chunk = 56, 16
+
+    def disagg_mode(chunked: bool, pooled: bool) -> dict:
+        eng = Engine(params, cfg, ServeConfig(
+            slots=slots, max_len=max_len, kv_block=block, prefix=True,
+            chunk_tokens=disagg_chunk if chunked else 0,
+        ))
+        hand = {"blocks": 0, "bytes": 0, "ms": 0.0}
+
+        def run_pass(seed: int, timed: bool) -> dict | None:
+            r2 = np.random.default_rng(seed)
+            long_prompt = r2.integers(0, cfg.vocab_size, disagg_long)
+            shorts = [
+                Request(
+                    prompt=r2.integers(
+                        0, cfg.vocab_size, prompt_lens[i % len(prompt_lens)]
+                    ),
+                    max_new_tokens=max_new, rng=seed * 1000 + i,
+                )
+                for i in range(n_req)
+            ]
+            if pooled:
+                peng = Engine(params, cfg, ServeConfig(
+                    slots=1, max_len=max_len, kv_block=block,
+                    prefix=True, pool="prefill",
+                ))
+                peng.run([Request(prompt=long_prompt, max_new_tokens=1)])
+                covered, payload = peng.export_prefix_blocks(long_prompt)
+                t0 = time.perf_counter()
+                wire = pack_payload(payload)
+                eng.adopt_blocks(covered, unpack_payload(
+                    wire["k"], wire["v"], wire["shape"], wire["dtype"],
+                    wire.get("k_scale", b""), wire.get("v_scale", b""),
+                ))
+                if timed:
+                    hand["blocks"] = payload.n_blocks
+                    hand["bytes"] = payload.nbytes
+                    hand["ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+                peng.close()
+            # half the shorts upfront, the long prompt lands at step 2,
+            # remaining shorts one every other step — the long prefill
+            # hits while every slot is mid-decode
+            rids = [eng.submit(r) for r in shorts[: n_req // 2]]
+            rest = shorts[n_req // 2:]
+            pending = Request(prompt=long_prompt, max_new_tokens=max_new,
+                              rng=seed)
+            first_seen: dict[int, float] = {}
+            finished: dict[int, tuple[float, int, float]] = {}
+            i = 0
+            while eng._queue or eng.n_live or rest or pending is not None:
+                if i == 2 and pending is not None:
+                    rids.append(eng.submit(pending))
+                    pending = None
+                elif rest and i % 2 == 0:
+                    rids.append(eng.submit(rest.pop(0)))
+                eng.step()
+                now = time.perf_counter()
+                for rid in rids:
+                    if rid in finished:
+                        continue
+                    c = eng.completion_of(rid)
+                    if c is None or not c.tokens:
+                        continue
+                    first_seen.setdefault(rid, now)
+                    if c.finish_reason:
+                        finished[rid] = (now, len(c.tokens), c.ttft_s)
+                i += 1
+            for rid in rids:
+                eng.take_completion(rid)
+            if not timed:
+                return None
+            ttfts = sorted(v[2] for v in finished.values())
+            tpots = sorted(
+                (v[0] - first_seen[rid]) / max(v[1] - 1, 1)
+                for rid, v in finished.items()
+            )
+            return {
+                "ttft_p50_s": round(ttfts[len(ttfts) // 2], 5),
+                "ttft_p99_s": round(ttfts[-1], 5),
+                "tpot_p50_s": round(tpots[len(tpots) // 2], 5),
+                "tpot_p99_s": round(tpots[-1], 5),
+            }
+
+        def drain_store() -> None:
+            while eng._store.evict_lru(eng._pool.release) is not None:
+                pass
+
+        run_pass(10, False)   # warm 1: compiles + the one-time pool growth
+        drain_store()
+        run_pass(11, False)   # warm 2: every signature at the settled shape
+        drain_store()
+        r = run_pass(12, True)
+        eng.close()
+        if pooled:
+            r["handoff_blocks"] = hand["blocks"]
+            r["handoff_bytes"] = hand["bytes"]
+            r["handoff_ms"] = hand["ms"]
+        return r
+
+    disagg: dict = {"chunk_tokens": disagg_chunk,
+                    "long_prompt_tokens": disagg_long}
+    for chunked in (False, True):
+        for pooled in (False, True):
+            key = (("chunked" if chunked else "unchunked")
+                   + ("_pooled" if pooled else "_colocated"))
+            disagg[key] = disagg_mode(chunked, pooled)
+    base_p99 = disagg["unchunked_colocated"].get("tpot_p99_s", 0.0)
+    if base_p99 > 0:
+        # the chunking headline: how much of the long-prompt TPOT spike
+        # chunked prefill removes on a colocated gang (< 1 = bounded)
+        disagg["tpot_p99_chunked_ratio"] = round(
+            disagg["chunked_colocated"].get("tpot_p99_s", 0.0) / base_p99, 3
+        )
+    out["disagg"] = disagg
+
     # native-GQA decode kernel vs the repeat-expanded reference (one
     # decode step of attention at full cache length, layer-scanned so
     # dispatch overhead amortises)

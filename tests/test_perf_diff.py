@@ -123,6 +123,34 @@ class TestFlattenAndRules:
         assert rule_for(
             "extra.decode.quant.quant_on.peak_hbm_gb"
         )[0] == "lower"
+        # disaggregated serving (bench decode.disagg): the chunked/
+        # unchunked TPOT-p99 ratio is lower-better (drifting toward 1.0
+        # means chunked prefill stopped bounding the long-prompt
+        # interference); the chunk size and scenario prompt length are
+        # configuration identity; the handoff payload is trace-shaped —
+        # bytes/blocks (and the per-host shipped/adopted/freed counters
+        # in series rollups) must never be judged as memory, while the
+        # handoff wall time stays a judged latency
+        assert rule_for(
+            "extra.decode.disagg.tpot_p99_chunked_ratio"
+        )[0] == "lower"
+        assert rule_for(
+            "extra.decode.disagg.chunked_colocated.tpot_p99_s"
+        )[0] == "lower"
+        assert rule_for("extra.decode.disagg.chunk_tokens")[0] == "config"
+        assert rule_for(
+            "extra.decode.disagg.long_prompt_tokens"
+        )[0] == "config"
+        assert rule_for(
+            "extra.decode.disagg.unchunked_pooled.handoff_bytes"
+        )[0] == "skip"
+        assert rule_for(
+            "extra.decode.disagg.unchunked_pooled.handoff_blocks"
+        )[0] == "skip"
+        assert rule_for("decode_0.handoff_shipped_blocks")[0] == "skip"
+        assert rule_for(
+            "extra.decode.disagg.unchunked_pooled.handoff_ms"
+        )[0] == "lower"
 
     def test_headroom_collapse_is_a_regression(self):
         v = diff(
@@ -182,6 +210,14 @@ class TestVerdict:
         assert "extra.gqa_capacity.max_slots_quant" in keys
         assert "extra.gqa_capacity.quant_slot_ratio" in keys
         assert "extra.decode.quant.tok_s_ratio" in keys
+        # the disaggregated-serving section gates too: the chunked TPOT
+        # tail blowing back toward the unchunked one (the interference
+        # chunking exists to bound) and a slowed handoff both flag; the
+        # unchanged payload size stays silent (trace-shaped, skipped)
+        assert "extra.decode.disagg.chunked_colocated.tpot_p99_s" in keys
+        assert "extra.decode.disagg.tpot_p99_chunked_ratio" in keys
+        assert "extra.decode.disagg.chunked_pooled.handoff_ms" in keys
+        assert "extra.decode.disagg.chunked_pooled.handoff_bytes" not in keys
         # within-tolerance drift is NOT flagged
         assert "extra.loss" not in keys          # +0.04% << 2%
         assert "extra.peak_hbm_gb" not in keys   # +1.5% << 10%

@@ -481,6 +481,8 @@ def test_frontend_autoscale_tick_calls_store_hooks(tmp_path):
 # --- THE e2e: chaos kill_container on a decode host mid-stream ---------------
 
 
+@pytest.mark.slow  # ~48s: full client->AM->2-host stack; the pooled
+# prefill-kill e2e below keeps the kill/reprefill path under tier-1
 def test_gang_serve_e2e_kill_container_midstream(tmp_path):
     """Acceptance: a REAL client -> AM -> 2-decode-host serve job; chaos
     SIGKILLs decode:0's container the heartbeat after the test observes a
@@ -610,3 +612,415 @@ def test_gang_serve_e2e_kill_container_midstream(tmp_path):
     merged = json.load(open(os.path.join(app_dir, "trace.json")))
     names = {e.get("name") for e in merged["traceEvents"]}
     assert {"serve.request", "serve.reprefill", "chaos.kill_container"} <= names
+
+
+# --- chunked prefill ----------------------------------------------------------
+
+
+def test_chunk_tokens_must_be_block_aligned(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="multiple of kv_block"):
+        Engine(params, cfg, ServeConfig(
+            slots=1, max_len=64, kv_block=8, chunk_tokens=12,
+        ))
+
+
+@pytest.mark.slow  # four features live at once means paying every engine
+# signature twice; the cheap chunking/handoff tests above keep tier-1 cover
+def test_chunked_prefill_parity_with_everything_live(tiny):
+    """The chunked-prefill acceptance gate: long unshared tails prefill in
+    block-aligned chunks interleaved with decode steps, with prefix
+    sharing, speculation, quantized KV AND int8 weights all live — and the
+    tokens stay draw-for-draw what generate()'s identical quantized step
+    produces. Chunking reshapes the schedule, never the stream."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models.generate import generate
+
+    cfg, params = tiny
+    sv = dict(quant_kv="int8", quant_weights=True, prefix=True,
+              spec=True, spec_max_draft=3)
+    B, P, m = 3, 40, 6
+    rng = np.random.default_rng(11)
+    shared = rng.integers(1, cfg.vocab_size, 16)
+    prompts = np.stack([
+        np.concatenate([shared, rng.integers(1, cfg.vocab_size, P - 16)])
+        for _ in range(B)
+    ]).astype(np.int32)
+    key = jax.random.key(3)
+    keys = jax.random.split(key, B)
+    eng = Engine(params, cfg, ServeConfig(
+        slots=B, max_len=P + m, kv_block=8, chunk_tokens=16, **sv,
+    ))
+    rids = [
+        eng.submit(Request(prompt=prompts[i], max_new_tokens=m, rng=keys[i]))
+        for i in range(B)
+    ]
+    eng.step()
+    assert eng._chunking, "40-token prompts over chunk_tokens=16 must chunk"
+    assert eng.stats_snapshot()["chunking_slots"] >= 1
+    got = eng.run()
+    solo = generate(
+        params, jnp.asarray(prompts), cfg, max_new_tokens=m, rng=key,
+        serve=sv,
+    )
+    for i, rid in enumerate(rids):
+        assert got[rid].tokens == list(np.asarray(solo[i, P:])), i
+
+
+# --- blockwise KV handoff: serialization + adoption ---------------------------
+
+
+def _handoff_cfg(**kw):
+    base = dict(slots=2, max_len=64, kv_block=8, prefix=True)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def test_handoff_payload_roundtrip_bit_exact(tiny):
+    from tony_tpu.serve.cache import pack_payload, unpack_payload
+
+    cfg, params = tiny
+    for quant in ("", "int8"):
+        eng = Engine(params, cfg, _handoff_cfg(quant_kv=quant))
+        p = _prompt(32, seed=21)
+        eng.run([Request(prompt=p, max_new_tokens=1)])
+        covered, payload = eng.export_prefix_blocks([int(t) for t in p])
+        assert len(covered) == payload.n_blocks * 8 == 32
+        packed = pack_payload(payload)
+        back = unpack_payload(
+            packed["k"], packed["v"], packed["shape"], packed["dtype"],
+            k_scale=packed.get("k_scale", b""),
+            v_scale=packed.get("v_scale", b""),
+        )
+        assert np.array_equal(np.asarray(payload.k), np.asarray(back.k))
+        assert np.array_equal(np.asarray(payload.v), np.asarray(back.v))
+        if quant:
+            assert np.array_equal(
+                np.asarray(payload.k_scale), np.asarray(back.k_scale)
+            )
+            assert np.array_equal(
+                np.asarray(payload.v_scale), np.asarray(back.v_scale)
+            )
+        else:
+            assert back.k_scale is None and back.v_scale is None
+    # malformed payloads are refused, never adopted as garbage
+    with pytest.raises(ValueError):
+        unpack_payload(
+            packed["k"][:-3], packed["v"], packed["shape"], packed["dtype"],
+            k_scale=packed["k_scale"], v_scale=packed["v_scale"],
+        )
+    with pytest.raises(ValueError):
+        unpack_payload(packed["k"], packed["v"], [1, 2], packed["dtype"])
+
+
+@pytest.mark.slow  # three engines + a solo generate; the bit-exact
+# roundtrip test above carries the wire format in tier-1
+def test_export_adopt_refcount_cow_and_scratch(tiny):
+    """Adopted blocks enter the pool through the normal refcount rules:
+    fresh allocations (never the scratch block), exactly one owning store
+    reference each, idempotent re-ship frees everything, and a decode on
+    the adopter rides the hit draw-for-draw with solo generate()."""
+    import jax.numpy as jnp
+
+    from tony_tpu.models.generate import generate
+
+    cfg, params = tiny
+    sv = dict(quant_kv="int8", prefix=True)
+    src = Engine(params, cfg, _handoff_cfg(quant_kv="int8"))
+    p = _prompt(32, seed=22)
+    src.run([Request(prompt=p, max_new_tokens=1)])
+    covered, payload = src.export_prefix_blocks([int(t) for t in p])
+    dst = Engine(params, cfg, _handoff_cfg(quant_kv="int8"))
+    assert dst.adopt_blocks(covered, payload) == (payload.n_blocks, 0)
+    # idempotent re-ship: the prefix is already resident, every block frees
+    assert dst.adopt_blocks(covered, payload) == (0, payload.n_blocks)
+    m = dst._store.match([int(t) for t in p], 32)
+    assert len(m.full) == 4 and 0 not in m.full   # scratch never adopted
+    assert all(dst._pool._ref[pid] == 1 for pid in m.full)
+    rid = dst.submit(Request(prompt=p, max_new_tokens=4))
+    out = dst.run()
+    solo = generate(
+        params, jnp.asarray(p)[None], cfg, max_new_tokens=4, serve=sv,
+    )
+    assert out[rid].tokens == list(np.asarray(solo[0, 32:]))
+    assert dst.stats_snapshot()["prefix_hit_tokens"] >= 24
+    # a geometry/dtype mismatch is refused, never adopted as garbage
+    plain = Engine(params, cfg, _handoff_cfg(quant_kv=""))
+    with pytest.raises(ValueError, match="incompatible"):
+        plain.adopt_blocks(covered, payload)
+
+
+@pytest.mark.slow  # mid-decode handoff needs a live multi-slot engine;
+# the pooled kill e2e below exercises the same race under tier-1
+def test_handoff_racing_slot_free_never_corrupts(tiny):
+    """A handoff landing while a slot is mid-decode (or just freed) can
+    only allocate refcount-zero blocks: the live stream's blocks stay
+    untouched and its tokens stay draw-for-draw identical."""
+    import jax.numpy as jnp
+
+    from tony_tpu.models.generate import generate
+
+    cfg, params = tiny
+    src = Engine(params, cfg, _handoff_cfg())
+    p = _prompt(32, seed=23)
+    src.run([Request(prompt=p, max_new_tokens=1)])
+    covered, payload = src.export_prefix_blocks([int(t) for t in p])
+
+    dst = Engine(params, cfg, _handoff_cfg())
+    q = _prompt(24, seed=24)
+    rid = dst.submit(Request(prompt=q, max_new_tokens=6))
+    for _ in range(3):
+        dst.step()        # prefill + decode steps: the slot is live
+    slot = next(s for s, r in enumerate(dst._slot_rid) if r == rid)
+    live = set(dst._table[slot, :dst._slot_blocks[slot]].tolist())
+    created, freed = dst.adopt_blocks(covered, payload)
+    adopted = set(dst._store.match([int(t) for t in p], 32).full)
+    assert created == payload.n_blocks and freed == 0
+    assert not (adopted & live), "adoption wrote into a live slot's blocks"
+    out = dst.run()
+    solo = generate(params, jnp.asarray(q)[None], cfg, max_new_tokens=6)
+    assert out[rid].tokens == list(np.asarray(solo[0, 24:]))
+    # the finished slot's release returned blocks to the free list; a stale
+    # re-ship of the same payload still only touches refcount-zero ids and
+    # the pool's books stay balanced (used == store-owned)
+    while dst._store.evict_lru(dst._pool.release) is not None:
+        pass
+    assert dst.adopt_blocks(covered, payload) == (payload.n_blocks, 0)
+    owned = dst._store.match([int(t) for t in p], 32).full
+    assert all(dst._pool._ref[pid] == 1 for pid in owned)
+
+
+# --- per-pool autoscale -------------------------------------------------------
+
+
+def test_autoscale_policy_per_pool_windows():
+    """Each pool sustains its OWN window: a hot prefill pool must not
+    inherit the decode pool's timer (or vice versa)."""
+    pol = AutoscalePolicy(high=4, low=1, window_s=10)
+    t = 1000.0
+    assert pol.observe(9, t, pool="prefill") is None
+    assert pol.observe(0, t + 5, pool="decode") is None
+    # prefill has sustained 11s above; decode has sustained only 6s below
+    assert pol.observe(9, t + 11, pool="prefill") == "grow"
+    assert pol.observe(0, t + 11, pool="decode") is None
+    assert pol.observe(0, t + 16, pool="decode") == "shrink"
+
+
+def test_frontend_autoscale_per_pool_grows_the_right_ask(tmp_path):
+    """A dict tick scales each pool independently and a grow leases that
+    pool's own container shape — a heterogeneous gang must never grow the
+    wrong pool."""
+    from tony_tpu.cluster.backend import Resource
+    from tony_tpu.cluster.lease import GangAsk, LeaseStore
+
+    store = LeaseStore(str(tmp_path / "rm"))
+    store.register_hosts({"h1": Resource(16384, 16, 8)})
+    store.reserve_gang(
+        "serve-pools", [GangAsk(Resource(1024, 1, 0))], timeout_s=0
+    )
+    settings = GangSettings(
+        autoscale_queue_high=4, autoscale_queue_low=0, autoscale_window_s=1.0,
+    )
+    fe = GangFrontend(
+        "", settings, lease_store=store, app_id="serve-pools",
+        grow_asks={
+            "decode": GangAsk(Resource(2048, 2, 4)),
+            "prefill": GangAsk(Resource(4096, 4, 2)),
+        },
+    )
+    try:
+        t = 100.0
+        fe.autoscale_tick({"decode": 2, "prefill": 9}, t)
+        assert fe.autoscale_tick({"decode": 2, "prefill": 9}, t + 1.5) == "grow"
+        actions = fe.autoscale_actions
+        assert [a for a, _ in actions] == ["grow"]
+        assert "pool=prefill" in actions[0][1] and "leased h1" in actions[0][1]
+        leases = store.summary()["apps"]["serve-pools"]["leases"]
+        grown = [l for l in leases if l["memory_mb"] != 1024]
+        # the lease carries the PREFILL container shape, not the decode one
+        assert len(grown) == 1
+        assert grown[0]["memory_mb"] == 4096 and grown[0]["tpu_chips"] == 2
+        # ...booked under the prefill pool's own gang so a shrink of one
+        # pool can never hand back the other's container
+        assert store.shrink_gang("serve-pools", "serve-autoscale-prefill")
+        assert store.shrink_gang("serve-pools", "serve-autoscale") is None
+    finally:
+        fe.close(wait_s=0.0)
+        store.release_app("serve-pools")
+
+
+# --- handoff ledger invariant: firing + non-firing fixtures -------------------
+
+
+def test_handoff_invariant_fires_and_passes(tmp_path):
+    ok_req = {"rid": "r1", "tokens": 8, "finish_reason": "length",
+              "ttft_s": 0.2, "replays": 0, "replay_consistent": True}
+    clean = _app_with_ledger(tmp_path, "handoff-clean", {
+        "proc": "frontend", "pending": [], "requests": [ok_req],
+        "handoffs": [
+            # balanced success, and a failed handoff whose request still
+            # completed via re-prefill: both pass
+            {"rid": "r1", "prefill_host": "prefill:0",
+             "decode_host": "decode:0", "shipped": 4, "adopted": 3,
+             "freed": 1, "ok": True, "message": ""},
+            {"rid": "r1", "prefill_host": "prefill:0",
+             "decode_host": "decode:0", "shipped": 0, "adopted": 0,
+             "freed": 0, "ok": False, "message": "prefill host lost"},
+        ],
+    })
+    assert check_invariants([clean]).ok
+
+    bad = _app_with_ledger(tmp_path, "handoff-leaky", {
+        "proc": "frontend", "pending": [],
+        "requests": [ok_req,
+                     {"rid": "r2", "tokens": 0, "finish_reason": "error",
+                      "ttft_s": 0.0, "replays": 0,
+                      "replay_consistent": True}],
+        "handoffs": [
+            # 4 shipped but only 3 accounted for on the adopter: a leak
+            {"rid": "r1", "shipped": 4, "adopted": 2, "freed": 1,
+             "ok": True, "message": ""},
+            # failed handoff AND the request never completed: stranded
+            {"rid": "r2", "shipped": 1, "adopted": 0, "freed": 0,
+             "ok": False, "message": "ship failed"},
+        ],
+    })
+    report = check_invariants([bad])
+    leaks = [v for v in report.violations
+             if v.invariant == "handoff-no-block-leak"]
+    assert len(leaks) == 2
+    assert any("leaked" in v.detail for v in leaks)
+    assert any("never completed" in v.detail for v in leaks)
+
+
+# --- pooled frontend: handoff happy path + prefill-host kill mid-handoff ------
+
+
+def test_pooled_frontend_prefill_kill_mid_handoff(tmp_path):
+    """In-process disaggregated gang (1 prefill + 1 decode host). First a
+    clean handoff: blocks ship ahead of the Generate and the decode host
+    admits on the hit. Then chaos arms an on_file delay at the
+    serve.handoff seam (post-export, pre-ship) and the prefill host is
+    hard-killed inside that window: the frontend's Prefill RPC dies
+    mid-handoff, the record lands ok=False, the request still completes
+    via re-prefill on the decode host, and both serve invariants
+    (no-request-lost + handoff-no-block-leak) pass over the real ledger."""
+    from tony_tpu.chaos import active_injector, install_from_config, uninstall
+    from tony_tpu.rpc import serve_rpc
+
+    settings = GangSettings(
+        model="tiny", slots=2, max_len=128, max_queue=8,
+        prefill_hosts=1, handoff_min_tokens=64,
+    )
+    svc_p = DecodeHostService(
+        lambda: build_gang_engine(settings, pool="prefill"),
+        "prefill:0", pool="prefill",
+    )
+    svc_d = DecodeHostService(
+        lambda: build_gang_engine(settings, pool="decode"),
+        "decode:0", pool="decode",
+    )
+    srv_p, port_p = serve_rpc(svc_p, host="127.0.0.1", port=0)
+    srv_d, port_d = serve_rpc(svc_d, host="127.0.0.1", port=0)
+    svc_p.start()
+    svc_d.start()
+    fe = GangFrontend("", settings)
+    fe.add_host("decode:0", f"127.0.0.1:{port_d}", pool="decode")
+    fe.add_host("prefill:0", f"127.0.0.1:{port_p}", pool="prefill")
+    trigger = tmp_path / "kill-now"
+    try:
+        # clean handoff: the decode pool serves, the prefill pool ships
+        c1 = fe.result(fe.submit(_prompt(80, seed=31), 4), timeout_s=120)
+        assert c1.finish_reason == "length" and c1.hosts == ["decode:0"]
+        with fe._lock:
+            h1 = dict(fe._handoffs[-1])
+        assert h1["ok"] and h1["shipped"] == h1["adopted"] + h1["freed"] > 0
+        # the wire-visible pool label (tony top's split view reads this)
+        from tony_tpu.rpc.service import ServeRpcClient
+
+        with ServeRpcClient(f"127.0.0.1:{port_p}") as cli:
+            assert cli.decode_stats().pool == "prefill"
+        with ServeRpcClient(f"127.0.0.1:{port_d}") as cli:
+            assert cli.decode_stats().pool == "decode"
+
+        # arm the mid-handoff window and kill the prefill host inside it
+        cfg = TonyConfig({
+            "chaos.enabled": True,
+            "chaos.faults": json.dumps([{
+                "type": "delay_point", "point": "serve.handoff",
+                "on_file": str(trigger), "delay_ms": 2500,
+            }]),
+        })
+        assert install_from_config(cfg, role="serve") is True
+        trigger.write_text("")
+        rid = fe.submit(_prompt(80, seed=32), 4)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            inj = active_injector()
+            if inj is not None and inj.fired:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("the serve.handoff fault never fired")
+        srv_p.stop(None)  # hard prefill-host death, Prefill RPC in flight
+        c2 = fe.result(rid, timeout_s=120)
+        assert c2.finish_reason == "length" and len(c2.tokens) == 4
+        assert c2.hosts == ["decode:0"]   # completed via re-prefill there
+        with fe._lock:
+            h2 = dict(fe._handoffs[-1])
+        assert not h2["ok"] and "prefill host lost" in h2["message"]
+
+        ledger = fe.close()
+        assert not ledger["pending"] and len(ledger["handoffs"]) == 2
+        app = _app_with_ledger(tmp_path, "pooled-app", ledger)
+        report = check_invariants([app])
+        assert report.ok, report.to_json()
+    finally:
+        uninstall()
+        fe._closed.set()
+        svc_p.shutdown()
+        svc_d.shutdown()
+        srv_p.stop(0)
+        srv_d.stop(0)
+
+
+# --- tony top: pool column + per-pool quantile rollup -------------------------
+
+
+def test_top_pool_column_and_rollup(tmp_path):
+    from tony_tpu.obs.top import build_view, render
+
+    app = tmp_path / "app-pools"
+    sdir = app / "series"
+    sdir.mkdir(parents=True)
+    now = time.time()
+    (sdir / "decode_0_user.jsonl").write_text(json.dumps({
+        "ts": now - 1, "pool": "decode", "queue_depth": 2, "occupancy": 0.5,
+        "ttft_n": 8, "ttft_p50_s": 0.2, "ttft_p99_s": 0.9,
+        "tpot_n": 80, "tpot_p50_s": 0.01, "tpot_p99_s": 0.05,
+    }) + "\n")
+    # AM-rollup row: the numeric push dropped the pool string, so the
+    # task TYPE is the membership
+    (sdir / "am_rollup.json").write_text(json.dumps({"tasks": {
+        "prefill:0": {"last_ts": now - 1, "points": [{
+            "ts": now - 1, "queue_depth": 1, "occupancy": 0.25,
+            "ttft_n": 4, "ttft_p50_s": 0.6, "ttft_p99_s": 1.4,
+        }]},
+    }}))
+    (app / "status.json").write_text(
+        json.dumps({"state": "RUNNING", "exit_code": "", "tasks": []})
+    )
+    view = build_view(str(app), now=now)
+    rows = {r["proc"]: r for r in view["rows"]}
+    assert rows["decode_0_user"]["pool"] == "decode"
+    assert rows["prefill:0"]["pool"] == "prefill"
+    pools = view["pools"]
+    assert pools["decode"]["hosts"] == 1 and pools["prefill"]["hosts"] == 1
+    assert pools["decode"]["ttft_p99_s"] == 0.9
+    assert pools["decode"]["tpot_p50_s"] == 0.01
+    assert pools["prefill"]["ttft_p99_s"] == 1.4
+    frame = render(view)
+    assert "pool decode:" in frame and "pool prefill:" in frame
+    assert "tpot p50/p99" in frame

@@ -353,6 +353,9 @@ def test_scatter_block_kv_multi_position():
 # --- engine parity ------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~20s: double submission of four prompts across three
+# configs; impls_agree + the verify_and_accept reference-chain tests keep
+# spec parity under tier-1
 def test_engine_spec_matches_generate_greedy(setup):
     """Greedy engine output with spec on equals spec off equals solo
     generate() — on the SECOND submission of each prompt too, when the
@@ -527,6 +530,8 @@ def test_spec_metrics_and_snapshot(setup):
     assert prop == m.draft_proposed and acc == m.draft_accepted
 
 
+@pytest.mark.slow  # ~8s interaction test; spec parity and the health
+# monitors each have their own cheaper tier-1 coverage
 def test_spec_accepted_drafts_do_not_trip_health(setup, tmp_path):
     """Accepted multi-token steps report the autoregressive frontier's
     logits to the health monitors — a healthy model serving repeats with

@@ -331,6 +331,8 @@ def test_fit_pipeline_parallel_tiny_model(pp_schedule):
     assert final["final_loss"] < 5.2
 
 
+@pytest.mark.slow  # ~11s combination fit; the 1f1b pipeline fit and the
+# flash-attention kernel tests each stay under tier-1 on their own
 def test_fit_pipeline_with_flash_attention():
     """pp x flash: the pallas kernel runs region-local inside pipeline
     stages (no nested shard_map — shardy forbids re-binding axes)."""

@@ -61,6 +61,7 @@ POINTS = (
     "lease.locked",       # before each LeaseStore open/flock
     "rpc.server",         # before each served control-plane RPC
     "backend.allocate",   # before each container launch
+    "serve.handoff",      # after KV-block export, before ShipBlocks lands
 )
 
 _POINT_OF_TYPE = {

@@ -72,6 +72,16 @@ the guarantees the module docstrings promise in prose:
     > 0), no completed request's time-to-first-token exceeded it — the
     bounded-TTFT-under-kill serving contract.
 
+``handoff-no-block-leak``
+    Over every blockwise KV handoff the frontend ledgered (disaggregated
+    prefill pools, docs/SERVE.md "Disaggregated serving"): a successful
+    handoff accounted for every shipped block on the adopter
+    (``shipped == adopted + freed`` — an adopter that silently dropped a
+    block would leak it from the refcounted pool), and a failed handoff
+    (prefill host killed mid-ship, adopter refused the payload) stranded
+    nothing: the request must still have completed via re-prefill on the
+    decode host.
+
 ``elastic-no-data-loss``
     Over every elastic journal (``<app_dir>/elastic/journal_m*.jsonl``,
     docs/ELASTIC.md): the consumed step sequence is contiguous (no batch
@@ -441,6 +451,36 @@ def _check_serve_ledgers(app_dir: str, app_id: str, report: InvariantReport) -> 
             continue
         subject = f"{app_id}/{name}"
         budget = float(ledger.get("ttft_budget_s", 0) or 0)
+        completed = {
+            e.get("rid") for e in ledger.get("requests", [])
+            if e.get("finish_reason") in ("eos", "length")
+        }
+        for h in ledger.get("handoffs", []):
+            rid = h.get("rid", "?")
+            if h.get("ok"):
+                shipped = int(h.get("shipped", 0) or 0)
+                adopted = int(h.get("adopted", 0) or 0)
+                freed = int(h.get("freed", 0) or 0)
+                if shipped != adopted + freed:
+                    report.violations.append(
+                        Violation(
+                            "handoff-no-block-leak", subject,
+                            f"handoff for {rid} shipped {shipped} block(s) "
+                            f"but the adopter accounted for "
+                            f"{adopted} adopted + {freed} freed — the "
+                            "difference leaked from the refcounted pool",
+                        )
+                    )
+            elif rid not in completed:
+                report.violations.append(
+                    Violation(
+                        "handoff-no-block-leak", subject,
+                        f"handoff for {rid} failed "
+                        f"({h.get('message', '') or 'no message'}) and the "
+                        "request never completed — a dead prefill host must "
+                        "strand nothing: the decode host re-prefills",
+                    )
+                )
         for rid in ledger.get("pending", []):
             report.violations.append(
                 Violation(

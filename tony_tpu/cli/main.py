@@ -190,6 +190,16 @@ def cmd_serve(args: argparse.Namespace) -> int:
             job_key(gang_type, "command"),
             f"{sys.executable} -m tony_tpu.serve.gang",
         )
+    if settings.prefill_hosts > 0:
+        # disaggregated gang: a second task type carries the prefill pool
+        # (same worker binary; pool membership comes from the job name)
+        ptype = settings.prefill_job_type
+        config.set(job_key(ptype, "instances"), settings.prefill_hosts)
+        if not config.get_str(job_key(ptype, "command")):
+            config.set(
+                job_key(ptype, "command"),
+                f"{sys.executable} -m tony_tpu.serve.gang",
+            )
     client = TonyClient(config, src_dir=args.src_dir or "")
     client.stage()
     client.launch_am()
@@ -197,8 +207,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
     deliberate_stop = False
     try:
         am_addr = client.am_address()
-        print(f"[{client.app_id}] gang of {settings.hosts} x {gang_type} "
-              f"(model={settings.model})")
+        pools = f"gang of {settings.hosts} x {gang_type}"
+        if settings.prefill_hosts > 0:
+            pools += f" + {settings.prefill_hosts} x {settings.prefill_job_type}"
+        print(f"[{client.app_id}] {pools} (model={settings.model})")
         trace.install_from_config(
             config, client.app_dir, client.app_id, proc="frontend"
         )
@@ -207,15 +219,26 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
         rm_root = config.get_str(Keys.CLUSTER_RM_ROOT, "")
         gang_spec = config.task_spec(gang_type)
+        # autoscale asks must mirror the real containers, PER POOL — a
+        # heterogeneous gang growing on a prefill backlog must lease a
+        # prefill-sized container, not a decode one
+        grow_asks = {
+            "decode": GangAsk(
+                Resource(gang_spec.memory_mb, gang_spec.cpus, gang_spec.tpu_chips),
+                node_label=gang_spec.node_label,
+            ),
+        }
+        if settings.prefill_hosts > 0:
+            pspec = config.task_spec(settings.prefill_job_type)
+            grow_asks["prefill"] = GangAsk(
+                Resource(pspec.memory_mb, pspec.cpus, pspec.tpu_chips),
+                node_label=pspec.node_label,
+            )
         fe = GangFrontend(
             am_addr, settings, app_dir=client.app_dir,
             token=read_token(client.app_dir), app_id=client.app_id,
             lease_store=LeaseStore(rm_root) if rm_root else None,
-            # autoscale asks must mirror the real decode container
-            grow_ask=GangAsk(
-                Resource(gang_spec.memory_mb, gang_spec.cpus, gang_spec.tpu_chips),
-                node_label=gang_spec.node_label,
-            ),
+            grow_asks=grow_asks,
         )
         ready = fe.wait_ready()
         print(f"[{client.app_id}] {ready} decode host(s) serving")

@@ -244,6 +244,25 @@ class Keys:
     # channel scales (prefill keeps the bf16 master weights)
     SERVE_QUANT_WEIGHTS = "serve.quant.weights"
 
+    # --- chunked prefill + disaggregated pools (docs/SERVE.md
+    # "Disaggregated serving") ---
+    # prompts whose unshared tail exceeds this prefill in block-aligned
+    # chunks, one chunk per decode step, so a long prompt cannot stall
+    # co-resident streams (TPOT stays bounded, TTFT degrades gracefully);
+    # must be a multiple of serve.gang.kv block size; 0 = off
+    SERVE_CHUNK_TOKENS = "serve.chunk_tokens"
+    # containers in the prefill pool (0 = colocated serving, no pool split);
+    # when > 0 the serve gang is heterogeneous: the AM schedules this many
+    # prefill-type containers next to serve.gang.hosts decode ones, and the
+    # frontend routes long prompts through prefill -> ShipBlocks -> decode
+    SERVE_POOL_PREFILL_HOSTS = "serve.pool.prefill_hosts"
+    # task-type name of the prefill pool (job.<type>.* keys configure its
+    # containers; same worker binary as the decode pool)
+    SERVE_POOL_PREFILL_JOB_TYPE = "serve.pool.prefill_job_type"
+    # minimum prompt tokens before the frontend routes through the prefill
+    # pool — short prompts prefill faster in place than a handoff round-trip
+    SERVE_POOL_HANDOFF_MIN_TOKENS = "serve.pool.handoff_min_tokens"
+
     # --- cluster backend ---
     # Deliberate non-goals vs the reference key surface: docker keys (no
     # container runtime in this environment — processes are the container
@@ -405,6 +424,10 @@ DEFAULTS: dict[str, object] = {
     Keys.SERVE_QUANT_ENABLED: False,
     Keys.SERVE_QUANT_KV_DTYPE: "int8",
     Keys.SERVE_QUANT_WEIGHTS: False,
+    Keys.SERVE_CHUNK_TOKENS: 0,
+    Keys.SERVE_POOL_PREFILL_HOSTS: 0,
+    Keys.SERVE_POOL_PREFILL_JOB_TYPE: "prefill",
+    Keys.SERVE_POOL_HANDOFF_MIN_TOKENS: 64,
     Keys.CLUSTER_BACKEND: "local",
     Keys.CLUSTER_TPU_CHIPS_PER_HOST: 4,
     Keys.CLUSTER_HOSTS: "",

@@ -66,6 +66,19 @@ DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
     (r"(elastic.*(members|reshards)$|generation_changes)", "config", 0.0),
     (r"(lost_steps)", "lower", 0.0),
     (r"(restart_s|reshard_s|shrunk_step_ratio)", "lower", 0.25),
+    # disaggregated serving (engine chunked prefill + serve/gang.py pool
+    # handoff, bench `decode.disagg`): the chunked/unchunked TPOT-p99
+    # ratio is the long-prompt-interference headline — lower is better,
+    # and it carries no terminal latency token so it would otherwise go
+    # unjudged. The chunk size and the scenario's long-prompt length are
+    # configuration identity: silently shrinking the chunk (or the
+    # prompt) would make interference look "fixed". The handoff payload
+    # is trace-shaped — blocks/bytes scale with the shipped prefix, so
+    # the memory catch-all below must not judge a longer handoff as a
+    # regression (handoff_ms stays judged by the latency rule).
+    (r"tpot_p99_chunked_ratio", "lower", 0.10),
+    (r"(chunk_tokens|long_prompt_tokens)", "config", 0.0),
+    (r"handoff_.*(bytes|blocks)", "skip", 0.0),
     # throughput-shaped (and headroom: MORE free HBM is better — this
     # must outrank the broad memory rule below or a headroom collapse
     # would be judged as a memory improvement): higher is better

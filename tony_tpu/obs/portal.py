@@ -307,6 +307,9 @@ class PortalData:
             "app_id": app_id, "requests": 0, "finished": 0, "errors": 0,
             "replays": 0, "rejected": 0, "pending": 0, "ttft_max_s": 0.0,
             "ledgers": [],
+            # disaggregated-gang handoff rollup (zeros for classic gangs)
+            "handoffs": 0, "handoff_failures": 0,
+            "handoff_blocks_shipped": 0, "handoff_bytes": 0,
         }
         serve_dir = os.path.join(app_dir, "serve")
         if not os.path.isdir(serve_dir):
@@ -336,6 +339,13 @@ class PortalData:
                 out["ttft_max_s"] = max(
                     out["ttft_max_s"], float(entry.get("ttft_s", 0.0))
                 )
+            for h in ledger.get("handoffs", []):
+                if h.get("ok"):
+                    out["handoffs"] += 1
+                else:
+                    out["handoff_failures"] += 1
+                out["handoff_blocks_shipped"] += int(h.get("shipped", 0))
+                out["handoff_bytes"] += int(h.get("bytes", 0))
         return out
 
     def serve_summaries(self) -> dict[str, dict]:

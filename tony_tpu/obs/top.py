@@ -69,6 +69,58 @@ def _task_of_proc(proc: str) -> str:
     return proc
 
 
+def _pool_of(task: str, latest: dict) -> str:
+    """Pool column for serving rows. The engine journals its own pool
+    label (a string riding the series point); AM-rollup rows lost it (the
+    metrics push is numeric-only), so the task TYPE is the membership —
+    pool assignment in a disaggregated gang is by task type. Non-serve
+    rows stay blank."""
+    pool = latest.get("pool")
+    if isinstance(pool, str) and pool:
+        return pool
+    if any(k in latest for k in ("occupancy", "tpot_p50_s", "ttft_p50_s")):
+        jt = task.partition(":")[0]
+        if jt in ("prefill", "decode"):
+            return jt
+    return ""
+
+
+def _pool_rollup(rows: list[dict]) -> dict[str, dict]:
+    """Split TTFT/TPOT view per pool. Per-host quantiles cannot be merged
+    exactly, so the rollup reports the observation-weighted mean p50 and
+    the WORST host's p99 — the per-pool SLO question is "is any host of
+    this pool blowing its tail", and max answers it conservatively."""
+    pools: dict[str, dict] = {}
+    for row in rows:
+        pool = row.get("pool")
+        if not pool:
+            continue
+        latest = row["latest"]
+        agg = pools.setdefault(pool, {"hosts": 0, "queue_depth": 0.0})
+        agg["hosts"] += 1
+        agg["queue_depth"] += float(latest.get("queue_depth") or 0.0)
+        for prefix in ("ttft", "tpot"):
+            n = latest.get(f"{prefix}_n")
+            p50 = latest.get(f"{prefix}_p50_s")
+            p99 = latest.get(f"{prefix}_p99_s")
+            if not n or p50 is None or p99 is None:
+                continue
+            agg[f"{prefix}_n"] = agg.get(f"{prefix}_n", 0.0) + float(n)
+            agg[f"_{prefix}_p50_sum"] = (
+                agg.get(f"_{prefix}_p50_sum", 0.0) + float(p50) * float(n)
+            )
+            agg[f"{prefix}_p99_s"] = max(
+                agg.get(f"{prefix}_p99_s", 0.0), float(p99)
+            )
+    for agg in pools.values():
+        for prefix in ("ttft", "tpot"):
+            n = agg.get(f"{prefix}_n", 0.0)
+            s = agg.pop(f"_{prefix}_p50_sum", 0.0)
+            if n:
+                agg[f"{prefix}_p50_s"] = round(s / n, 4)
+    return pools
+
+
 def build_view(app_dir: str, *, now: float | None = None) -> dict[str, Any]:
     """Everything one frame renders, as data (tests assert on this; the
     renderer only formats)."""
@@ -108,6 +160,7 @@ def build_view(app_dir: str, *, now: float | None = None) -> dict[str, Any]:
         "state": str(status.get("state", "RUNNING?")),
         "ts": now,
         "rows": rows,
+        "pools": _pool_rollup(rows),
         "slo": {"verdict": slo_roll["verdict"], "tripped": slo_roll["slos"]},
         "health": {"verdict": health_roll["verdict"],
                    "rules": health_roll["rules"]},
@@ -155,6 +208,7 @@ def _row(proc: str, task: str, rec: dict, slo_by_proc: dict,
     return {
         "proc": proc,
         "task": task,
+        "pool": _pool_of(task, latest),
         "latest": latest,
         "age_s": rec.get("age_s", 0.0),
         "stale": rec.get("age_s", 0.0) > 30.0,
@@ -177,8 +231,19 @@ def render(view: dict[str, Any]) -> str:
         lines.append(
             "  TRIPPED SLOs: " + ", ".join(sorted(view["slo"]["tripped"]))
         )
+    for pool in sorted(view.get("pools") or {}):
+        agg = view["pools"][pool]
+        parts = [f"{pool}: {agg['hosts']} host(s)"]
+        for prefix in ("ttft", "tpot"):
+            if f"{prefix}_p50_s" in agg:
+                parts.append(
+                    f"{prefix} p50/p99 {agg[f'{prefix}_p50_s']:.3f}/"
+                    f"{agg[f'{prefix}_p99_s']:.3f}s"
+                )
+        parts.append(f"queue {agg['queue_depth']:.0f}")
+        lines.append("  pool " + "  ".join(parts))
     header = (
-        f"{'proc':<26} {'age':>6} "
+        f"{'proc':<26} {'pool':<8} {'age':>6} "
         + " ".join(f"{h:>9}" for h, _, _ in _VALUE_COLS)
         + f" {'trend':<18} {'slo':<14} flags"
     )
@@ -200,7 +265,8 @@ def render(view: dict[str, Any]) -> str:
         if row["trend_key"]:
             trend = f"{trend} {row['trend_key'].split('_')[0]}"
         lines.append(
-            f"{row['proc']:<26} {age:>6} " + " ".join(cells)
+            f"{row['proc']:<26} {row.get('pool') or '-':<8} {age:>6} "
+            + " ".join(cells)
             + f" {trend:<18} {row['slo']:<14} {' '.join(row['flags'])}"
         )
     if not view["rows"]:

@@ -323,6 +323,8 @@ _SERVE_METHODS: dict[str, tuple[Any, Any, bool]] = {
     "Generate": (pb.InferenceRequest, pb.TokenChunk, True),
     "DecodeStats": (pb.DecodeStatsRequest, pb.DecodeStatsResponse, False),
     "Drain": (pb.DrainRequest, pb.DrainResponse, False),
+    "Prefill": (pb.PrefillRequest, pb.PrefillResponse, False),
+    "ShipBlocks": (pb.ShipBlocksRequest, pb.ShipBlocksResponse, False),
 }
 
 
@@ -336,6 +338,12 @@ class ServeRpcServicer:
         raise NotImplementedError
 
     def Drain(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def Prefill(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def ShipBlocks(self, request, context):  # noqa: N802
         raise NotImplementedError
 
 
@@ -485,6 +493,29 @@ class ServeRpcClient:
 
     def decode_stats(self, timeout_s: float | None = None) -> pb.DecodeStatsResponse:
         return self._call("DecodeStats", pb.DecodeStatsRequest(), timeout_s)
+
+    def prefill(
+        self,
+        rid: str,
+        prompt: list[int],
+        target: str,
+        rng_seed: int = 0,
+        timeout_s: float | None = None,
+    ) -> pb.PrefillResponse:
+        """Ask a prefill host to prefill ``prompt`` and ship the finished KV
+        blocks to ``target`` (a decode host address)."""
+        return self._call(
+            "Prefill",
+            pb.PrefillRequest(rid=rid, prompt=prompt, target=target, rng_seed=rng_seed),
+            timeout_s,
+        )
+
+    def ship_blocks(
+        self, request: pb.ShipBlocksRequest, timeout_s: float | None = None
+    ) -> pb.ShipBlocksResponse:
+        """Stream a finished block payload to a decode host (prefill -> decode
+        edge of the handoff; the caller builds the request from pack_payload)."""
+        return self._call("ShipBlocks", request, timeout_s)
 
     def drain(
         self, timeout_s: float = 0.0, recycle: bool = False,

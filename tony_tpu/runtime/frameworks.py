@@ -263,6 +263,18 @@ class ServeRuntime(Runtime):
                 f"serve jobs need a [job.{gang_type}] section (or set "
                 "serve.gang.job_type to the decode-host task type)"
             )
+        if config.get_int(Keys.SERVE_POOL_PREFILL_HOSTS, 0) > 0:
+            ptype = config.get_str(Keys.SERVE_POOL_PREFILL_JOB_TYPE, "prefill")
+            if ptype not in config.job_types():
+                raise ValueError(
+                    f"disaggregated serve jobs need a [job.{ptype}] section "
+                    "for the prefill pool (serve.pool.prefill_hosts > 0)"
+                )
+            if ptype == gang_type:
+                raise ValueError(
+                    "serve.pool.prefill_job_type must differ from "
+                    "serve.gang.job_type (the pools are distinct task types)"
+                )
 
     def needs_data_port(self) -> bool:
         return True

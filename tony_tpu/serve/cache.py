@@ -50,11 +50,13 @@ only has to zero the scale row at allocation, never the block itself.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # physical block 0 is reserved: dead slots' writes land here, and table
 # entries beyond a slot's allocation point at it (their tiles are masked
@@ -293,6 +295,183 @@ def quant_scatter_span(pool, scale, new, pids, offs, ub, qmax):
     return pool.at[pids, :, offs, :].set(row), sc_new
 
 
+class BlockPayload(NamedTuple):
+    """Host-side copy of whole physical blocks — the unit of the blockwise
+    KV handoff (docs/SERVE.md "Disaggregated serving"). ``k``/``v`` are
+    ``[L, n, Hkv, block, hd]`` in the pool's STORAGE dtype (quantized
+    payload ships as stored, never dequantized), and on a quantized pool
+    ``k_scale``/``v_scale`` ``[L, n, Hkv]`` float32 ride along — a block
+    without its scale rows is not decodable, so they travel as one unit."""
+
+    k: np.ndarray
+    v: np.ndarray
+    k_scale: np.ndarray | None = None
+    v_scale: np.ndarray | None = None
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def nbytes(self) -> int:
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
+
+
+def export_blocks(cache: PagedKVCache, pids) -> BlockPayload:
+    """Gather physical blocks ``pids`` to the host as a BlockPayload.
+
+    The gather pads the id list to a power of two with scratch (bounded
+    device-gather signatures, same policy as the engine's context gather)
+    and trims on the host. One explicit D2H per call — the handoff is a
+    designed sync point on the prefill host, never on a decode step."""
+    nb = len(pids)
+    pad = 1
+    while pad < nb:
+        pad *= 2
+    padded = np.full(pad, SCRATCH_BLOCK, np.int32)
+    padded[:nb] = pids
+    idx = jnp.asarray(padded)
+    k, v = _gather_blocks_fn(cache.quantized)(cache, idx)
+    if cache.quantized:
+        (k, ks), (v, vs) = k, v
+        return BlockPayload(
+            np.asarray(jax.device_get(k))[:, :nb],
+            np.asarray(jax.device_get(v))[:, :nb],
+            np.asarray(jax.device_get(ks))[:, :nb],
+            np.asarray(jax.device_get(vs))[:, :nb],
+        )
+    return BlockPayload(
+        np.asarray(jax.device_get(k))[:, :nb],
+        np.asarray(jax.device_get(v))[:, :nb],
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_blocks_fn(quant: bool = False):
+    if quant:
+        @jax.jit
+        def gat_q(cache: PagedKVCache, idx):
+            return (
+                (jnp.take(cache.k, idx, axis=1),
+                 jnp.take(cache.k_scale, idx, axis=1)),
+                (jnp.take(cache.v, idx, axis=1),
+                 jnp.take(cache.v_scale, idx, axis=1)),
+            )
+        return gat_q
+
+    @jax.jit
+    def gat(cache: PagedKVCache, idx):
+        return jnp.take(cache.k, idx, axis=1), jnp.take(cache.v, idx, axis=1)
+    return gat
+
+
+def write_block(cache: PagedKVCache, pid: int, payload: BlockPayload,
+                i: int) -> PagedKVCache:
+    """Adopt block ``i`` of ``payload`` into physical block ``pid``: the
+    decode-host side of the handoff. Payload dtype/shape must match the
+    pool exactly (checked by the caller via :func:`payload_compatible`) —
+    adoption is a raw store, scale rows included, so a shipped quantized
+    block decodes bit-identically to the block the prefill host held."""
+    if cache.quantized:
+        return _write_block_fn(True)(
+            cache, jnp.int32(pid),
+            jnp.asarray(payload.k[:, i]), jnp.asarray(payload.v[:, i]),
+            jnp.asarray(payload.k_scale[:, i]),
+            jnp.asarray(payload.v_scale[:, i]),
+        )
+    return _write_block_fn(False)(
+        cache, jnp.int32(pid),
+        jnp.asarray(payload.k[:, i]), jnp.asarray(payload.v[:, i]),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _write_block_fn(quant: bool = False):
+    if quant:
+        @jax.jit
+        def wr_q(cache: PagedKVCache, pid, kb, vb, ks, vs):
+            return cache._replace(
+                k=cache.k.at[:, pid].set(kb),
+                v=cache.v.at[:, pid].set(vb),
+                k_scale=cache.k_scale.at[:, pid].set(ks),
+                v_scale=cache.v_scale.at[:, pid].set(vs),
+            )
+        return wr_q
+
+    @jax.jit
+    def wr(cache: PagedKVCache, pid, kb, vb):
+        return cache._replace(
+            k=cache.k.at[:, pid].set(kb), v=cache.v.at[:, pid].set(vb)
+        )
+    return wr
+
+
+def payload_compatible(cache: PagedKVCache, payload: BlockPayload) -> str:
+    """'' when ``payload`` can be adopted into ``cache`` verbatim, else
+    the reason it cannot (dtype or geometry mismatch — a bf16 host must
+    not adopt int8 blocks and silently decode garbage)."""
+    want = cache.k.shape[:1] + cache.k.shape[2:]
+    got = payload.k.shape[:1] + payload.k.shape[2:]
+    if want != got:
+        return f"block geometry {got} != pool {want}"
+    if jnp.dtype(payload.k.dtype) != jnp.dtype(cache.k.dtype):
+        return f"payload dtype {payload.k.dtype} != pool {cache.k.dtype}"
+    if cache.quantized and payload.k_scale is None:
+        return "quantized pool needs scale rows in the payload"
+    if not cache.quantized and payload.k_scale is not None:
+        return "unquantized pool cannot adopt scaled payload"
+    return ""
+
+
+def pack_payload(payload: BlockPayload) -> dict:
+    """BlockPayload -> wire fields (raw bytes + shape + dtype name), the
+    ShipBlocks request body. ``np.tobytes`` round-trips every storage
+    dtype bit-exactly (bfloat16/fp8 via their ml_dtypes registrations)."""
+    d = {
+        "k": payload.k.tobytes(), "v": payload.v.tobytes(),
+        "shape": list(payload.k.shape), "dtype": jnp.dtype(payload.k.dtype).name,
+    }
+    if payload.k_scale is not None:
+        d["k_scale"] = np.ascontiguousarray(
+            payload.k_scale, np.float32).tobytes()
+        d["v_scale"] = np.ascontiguousarray(
+            payload.v_scale, np.float32).tobytes()
+    return d
+
+
+def unpack_payload(k: bytes, v: bytes, shape, dtype: str,
+                   k_scale: bytes = b"", v_scale: bytes = b"") -> BlockPayload:
+    """Wire fields -> BlockPayload (the ShipBlocks server side). Raises
+    ValueError on a malformed body — the RPC layer maps it to an error
+    response instead of corrupting the pool."""
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != 5:
+        raise ValueError(f"payload shape {shape} is not [L, n, Hkv, blk, hd]")
+    dt = jnp.dtype(dtype)
+    n = int(np.prod(shape))
+    if len(k) != n * dt.itemsize or len(v) != n * dt.itemsize:
+        raise ValueError(
+            f"payload bytes {len(k)}/{len(v)} do not match shape {shape} "
+            f"dtype {dtype}"
+        )
+    ka = np.frombuffer(k, dtype=dt).reshape(shape)
+    va = np.frombuffer(v, dtype=dt).reshape(shape)
+    if not k_scale:
+        return BlockPayload(ka, va)
+    sshape = shape[:3]
+    sn = int(np.prod(sshape)) * 4
+    if len(k_scale) != sn or len(v_scale) != sn:
+        raise ValueError(f"scale bytes do not match shape {sshape}")
+    return BlockPayload(
+        ka, va,
+        np.frombuffer(k_scale, dtype=np.float32).reshape(sshape),
+        np.frombuffer(v_scale, dtype=np.float32).reshape(sshape),
+    )
+
+
 def block_bytes(cfg, block: int, dtype=None, quant_kv: str = "") -> int:
     """HBM bytes one physical block costs (K + V across all layers).
     With ``quant_kv`` the payload is priced at the quantized dtype plus
@@ -402,16 +581,22 @@ class BlockPool:
 __all__ = [
     "KV_QUANT_DTYPES",
     "SCRATCH_BLOCK",
+    "BlockPayload",
     "BlockPool",
     "PagedKVCache",
     "block_bytes",
     "blocks_for",
     "create_cache",
     "dequantize_values",
+    "export_blocks",
     "grow_cache",
     "kv_quant_spec",
+    "pack_payload",
+    "payload_compatible",
     "quant_scatter_span",
     "quantize_values",
     "scatter_block_kv",
     "shrink_cache",
+    "unpack_payload",
+    "write_block",
 ]

@@ -69,9 +69,10 @@ from tony_tpu.obs.registry import HistogramWindow, Registry, snapshot_to_app_dir
 from tony_tpu.ops.decode_attention import decode_attention
 from tony_tpu.ops.quant_mm import quant_matmul, quantize_weights
 from tony_tpu.serve.cache import (
-    SCRATCH_BLOCK, BlockPool, PagedKVCache, block_bytes, blocks_for,
-    create_cache, dequantize_values, grow_cache, kv_quant_spec,
-    quant_scatter_span, scatter_block_kv, shrink_cache,
+    SCRATCH_BLOCK, BlockPayload, BlockPool, PagedKVCache, block_bytes,
+    blocks_for, create_cache, dequantize_values, export_blocks, grow_cache,
+    kv_quant_spec, payload_compatible, quant_scatter_span, scatter_block_kv,
+    shrink_cache, write_block,
 )
 from tony_tpu.serve.prefix import MatchResult, PrefixStore
 from tony_tpu.serve.spec import (
@@ -147,6 +148,19 @@ class ServeConfig:
     # traffic; requires quant_kv unset or set independently (orthogonal
     # knobs under one serve.quant.* config group).
     quant_weights: bool = False
+    # chunked prefill (serve.chunk_tokens; docs/SERVE.md "Disaggregated
+    # serving"): a prompt whose unshared tail exceeds this many tokens
+    # prefills in chunk_tokens-sized chunks through the restartable
+    # tail-prefill path, ONE chunk per engine step — a long prompt can no
+    # longer stall co-resident decode streams for a whole prefill (TPOT
+    # stays bounded, its own TTFT degrades gracefully). Must be a
+    # multiple of kv_block (chunks start block-aligned, so tail-prefill
+    # compile signatures stay the bounded per-bucket set). 0 = off.
+    chunk_tokens: int = 0
+    # pool label this engine serves in ('decode' | 'prefill'): pure
+    # observability — stats_snapshot/series/`tony top` carry it so a
+    # disaggregated gang's two pools stay distinguishable in rollups
+    pool: str = "decode"
 
 
 class AdmissionRejected(RuntimeError):
@@ -177,6 +191,20 @@ class Completion:
     prompt_len: int = 0
     finish_reason: str = ""  # 'eos' | 'length'
     ttft_s: float = 0.0
+
+
+@dataclass
+class _ChunkedPrefill:
+    """Host-side progress of one slot's chunked prefill: the slot owns
+    its blocks (planned at admission) and advances ``pos`` by one chunk
+    per engine step until the final chunk samples the first token."""
+
+    rid: int
+    req: Request
+    prompt: np.ndarray
+    pos: int          # tokens already written (prefix match + done chunks)
+    key: Any          # the request's sampling key (spent by the FINAL chunk)
+    t0: float         # admission start (TTFT spans the whole chunked prefill)
 
 
 class _SlotState(NamedTuple):
@@ -274,6 +302,12 @@ class Engine:
             raise ValueError("spec_max_draft must be >= 1 with spec on")
         if serve.quant_kv:
             kv_quant_spec(serve.quant_kv)  # validate the knob at build time
+        if serve.chunk_tokens and serve.chunk_tokens % serve.kv_block:
+            raise ValueError(
+                f"chunk_tokens {serve.chunk_tokens} must be a multiple of "
+                f"kv_block {serve.kv_block} (chunks start block-aligned so "
+                "tail-prefill signatures stay bounded)"
+            )
         self.serve = ServeConfig(
             slots=serve.slots, max_len=max_len, kv_block=serve.kv_block,
             prefill_buckets=buckets, decode_impl=serve.decode_impl,
@@ -283,6 +317,7 @@ class Engine:
             spec_max_draft=serve.spec_max_draft,
             spec_draft_source=serve.spec_draft_source,
             quant_kv=serve.quant_kv, quant_weights=serve.quant_weights,
+            chunk_tokens=serve.chunk_tokens, pool=serve.pool,
         )
         S = self.serve.slots
         try:
@@ -349,6 +384,9 @@ class Engine:
             live=jnp.zeros((S,), bool),
         )
         self._queue: deque[tuple[int, Request]] = deque()
+        # slots mid-chunked-prefill (slot -> progress): they hold their
+        # blocks but stay out of the decode batch until the final chunk
+        self._chunking: dict[int, _ChunkedPrefill] = {}
         self._completions: dict[int, Completion] = {}
         self._slot_rid: list[int | None] = [None] * S
         self._slot_remaining: list[int] = [0] * S
@@ -479,6 +517,15 @@ class Engine:
         return sum(1 for r in self._slot_rid if r is not None)
 
     @property
+    def n_decoding(self) -> int:
+        """Live slots actually in the decode batch (a slot mid-chunked-
+        prefill holds its blocks but does not decode yet)."""
+        return sum(
+            1 for s, r in enumerate(self._slot_rid)
+            if r is not None and s not in self._chunking
+        )
+
+    @property
     def queue_depth(self) -> int:
         """Requests admitted but not yet slotted."""
         return len(self._queue)
@@ -516,7 +563,25 @@ class Engine:
             # HBM per cached token (block bytes / block positions): the
             # quantized-serving capacity win, live (`tony top`'s kvB/t)
             "kv_bytes_per_token": round(self.metrics.kv_bytes_per_token, 2),
+            # pool label (disaggregated gangs): a string, so it rides the
+            # series journal but the numeric AM metrics push drops it —
+            # AM-rollup consumers derive the pool from the task type instead
+            "pool": self.serve.pool,
         }
+        if self._chunking:
+            # slots mid-chunked-prefill: occupied but not decoding yet
+            snap["chunking_slots"] = float(len(self._chunking))
+        shipped = float(self._c_handoff_shipped.value)
+        adopted = float(self._c_handoff_adopted.value)
+        freed = float(self._c_handoff_freed.value)
+        if shipped or adopted or freed:
+            # blockwise handoff accounting: on a healthy host every
+            # shipped block lands adopted or freed SOMEWHERE — the chaos
+            # handoff-no-block-leak invariant audits the frontend's
+            # per-request ledger view of these
+            snap["handoff_shipped_blocks"] = shipped
+            snap["handoff_adopted_blocks"] = adopted
+            snap["handoff_freed_blocks"] = freed
         if self.serve.quant_kv:
             resident = float(self._pool.n_blocks * self._blk_bytes)
             snap["quant_pool_resident_bytes"] = resident
@@ -608,6 +673,18 @@ class Engine:
         self._g_quant_resident = reg.gauge(
             "tony_serve_quant_pool_resident_bytes",
             "HBM resident in the quantized KV pool (payload + scale rows)",
+        )
+        self._c_handoff_shipped = reg.counter(
+            "tony_serve_handoff_shipped_blocks_total",
+            "physical blocks exported for a blockwise KV handoff",
+        )
+        self._c_handoff_adopted = reg.counter(
+            "tony_serve_handoff_adopted_blocks_total",
+            "shipped blocks adopted into this pool (prefix-store owned)",
+        )
+        self._c_handoff_freed = reg.counter(
+            "tony_serve_handoff_freed_blocks_total",
+            "shipped blocks freed on arrival (prefix already resident)",
         )
 
     def reset_metrics(self) -> None:
@@ -714,8 +791,16 @@ class Engine:
         # disarmed): a broadcast window brackets decode steps exactly like
         # train steps, so `tony profile` anatomises serving hosts too
         profile.maybe_capture()
+        # chunked-prefill interleave: slots already chunking advance ONE
+        # chunk each per step (slots _admit parks into chunking below ran
+        # their first chunk inside admission — advancing them again here
+        # would burn two chunks in one step)
+        pending = sorted(self._chunking)
         self._admit()
-        if self.n_live:
+        for slot in pending:
+            if slot in self._chunking:
+                self._prefill_chunk(slot)
+        if self.n_decoding:
             self._decode_once()
         return self.n_live
 
@@ -796,15 +881,43 @@ class Engine:
             if m.full:
                 match = self._trim_match(plen, m)
                 matched = match.length
+        ct = self.serve.chunk_tokens
+        chunked = bool(ct) and plen - matched > ct
+        if chunked and match is not None and match.partial is not None:
+            # chunk starts must stay block-aligned (every chunk boundary
+            # is matched + i*chunk_tokens): cut a mid-block COW match back
+            # to its full blocks — at chunked-prompt lengths the lost
+            # sub-block overlap is noise against the prefill itself
+            match = MatchResult(
+                len(match.full) * self.serve.kv_block, match.full, None
+            )
+            matched = match.length
+        if self._store is not None and plen > 1:
             self._store.record_prompt(plen, matched)
             self._c_prompt_tokens.inc(plen)
             if matched:
                 self._c_prefix_hit.inc(matched)
         self.metrics.record_prompt(plen, matched)
+        key = _as_raw_key(req.rng, rid)
+        if chunked:
+            # chunked prefill: plan every prompt block now, then advance
+            # one chunk per engine step (docs/SERVE.md "Disaggregated
+            # serving" — co-resident decode streams never stall behind a
+            # whole-prompt prefill). The slot stays out of the decode
+            # batch (state.live False, decode writes scratch-steered)
+            # until the final chunk samples the first token.
+            with trace.span("serve.prefill", rid=rid, bucket=bucket,
+                            slot=slot, matched=matched, chunked=1):
+                self._plan_blocks(slot, plen, match)
+            self._slot_rid[slot] = rid
+            self._chunking[slot] = _ChunkedPrefill(
+                rid=rid, req=req, prompt=prompt, pos=matched, key=key, t0=t0,
+            )
+            self._prefill_chunk(slot)  # first chunk rides the admission step
+            return
         with trace.span("serve.prefill", rid=rid, bucket=bucket, slot=slot,
                         matched=matched):
             self._plan_blocks(slot, plen, match)
-            key = _as_raw_key(req.rng, rid)
             if match is None:
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :plen] = prompt
@@ -823,6 +936,39 @@ class Engine:
             # EXPLICIT sync: the sampled first token steers admission on
             # the host (transfer-guard-clean under GRAFT_SANITIZE)
             tok = int(jax.device_get(tok))
+        self._activate_slot(slot, rid, req, prompt, tok, carry, t0)
+
+    def _prefill_chunk(self, slot: int) -> None:
+        """Advance one chunked-prefill slot by ONE chunk (at most
+        chunk_tokens tokens through the restartable tail-prefill path).
+        Intermediate chunks discard the sampled token (their last_index
+        points mid-prompt); the final chunk's sample IS the request's
+        first token — same logits, same key as an unchunked prefill, so
+        chunking is draw-for-draw invisible in the output."""
+        job = self._chunking[slot]
+        plen = len(job.prompt)
+        end = min(job.pos + self.serve.chunk_tokens, plen)
+        final = 1 if end == plen else 0
+        with trace.span("serve.prefill_chunk", rid=job.rid, slot=slot,
+                        start=job.pos, end=end, final=final):
+            tok, carry = self._tail_prefill(
+                slot, job.prompt, job.pos, job.req, job.key, end=end
+            )
+            if final:
+                tok = int(jax.device_get(tok))
+        if not final:
+            job.pos = end
+            return
+        del self._chunking[slot]
+        self._activate_slot(
+            slot, job.rid, job.req, job.prompt, tok, carry, job.t0
+        )
+
+    def _activate_slot(self, slot: int, rid: int, req: Request,
+                       prompt: np.ndarray, tok: int, carry, t0: float) -> None:
+        """Post-prefill activation: the sampled first token lands, TTFT is
+        recorded, and the slot joins the decode batch."""
+        plen = len(prompt)
         self._register_prompt(slot, prompt)
         now = time.perf_counter()
         self.metrics.record_prefill(now - t0, now - self._submit_t[rid])  # popped below
@@ -1048,14 +1194,19 @@ class Engine:
         )
 
     def _tail_prefill(self, slot: int, prompt: np.ndarray, matched: int,
-                      req: Request, key):
+                      req: Request, key, end: int | None = None):
         """Prefill only the unshared tail: gather the matched prefix K/V
         from the pool (through the slot's own table, COW copy included)
         into a contiguous context, run the tail bucket through the model
         attending it, and scatter the tail K/V back into the slot's
-        private blocks. FLOPs scale with the tail, not the prompt."""
+        private blocks. FLOPs scale with the tail, not the prompt.
+
+        ``end`` bounds the prefill to ``prompt[matched:end]`` — the
+        chunked-prefill form (one chunk = one call with ``matched`` at the
+        previous chunk's end). The restartable-tail contract makes the
+        chained chunks bitwise-identical to one full prefill."""
         B = self.serve.kv_block
-        plen = len(prompt)
+        plen = end if end is not None else len(prompt)
         tail_len = plen - matched
         cap = self._m_total * B
         tb = self._bucket_for(tail_len)
@@ -1083,7 +1234,7 @@ class Engine:
             self.cache, jnp.asarray(gather)
         )
         tail = np.zeros((1, tb), np.int32)
-        tail[0, :tail_len] = prompt[matched:]
+        tail[0, :tail_len] = prompt[matched:plen]
         with self._ledger.label(f"serve.prefill_tail[{tb},{C}]"):
             tok, carry, tk, tv = self._get_tail_prefill(tb, C)(
                 self.params, ctx_k, ctx_v, jnp.asarray(tail),
@@ -1111,6 +1262,89 @@ class Engine:
                 self._maybe_shrink_pool()
         self._g_prefix_bytes.set(self._store.resident_bytes)
         self._g_prefix_nodes.set(self._store.n_nodes)
+
+    # --- blockwise KV handoff (docs/SERVE.md "Disaggregated serving") ---------
+
+    def export_prefix_blocks(
+        self, tokens: Sequence[int]
+    ) -> tuple[list[int], BlockPayload] | None:
+        """Prefill-host side of the handoff: gather the store-resident
+        full blocks covering ``tokens`` to the host as ``(covered_tokens,
+        BlockPayload)`` — quantized payload and scale rows travel
+        together. Each block is pinned (one extra pool reference) for the
+        duration of the gather so LRU eviction cannot hand it away
+        mid-export. None when nothing is resident."""
+        if self._store is None:
+            return None
+        B = self.serve.kv_block
+        toks = [int(t) for t in tokens]
+        n_full = len(toks) // B
+        if not n_full:
+            return None
+        m = self._store.match(toks, n_full * B)
+        if not m.full:
+            return None
+        for pid in m.full:
+            self._pool.retain(pid)
+        try:
+            payload = export_blocks(self.cache, list(m.full))
+        finally:
+            for pid in m.full:
+                self._pool.release(pid)
+        self._c_handoff_shipped.inc(len(m.full))
+        return toks[:len(m.full) * B], payload
+
+    def adopt_blocks(
+        self, tokens: Sequence[int], payload: BlockPayload
+    ) -> tuple[int, int]:
+        """Decode-host side: adopt shipped blocks into THIS pool through
+        the normal refcount rules. Every adopted block is freshly
+        allocated (reallocation hands out only refcount-zero ids, so a
+        handoff racing a slot-free can never corrupt a reallocated
+        block), written payload + scale rows in one device store, and
+        registered in the prefix store — which takes the owning
+        reference. Blocks whose prefix is already resident are freed
+        instead (the temp allocation releases). Every shipped block
+        therefore ends adopted or freed — the handoff-no-block-leak
+        contract the chaos checker audits. Returns (adopted, freed);
+        raises ValueError on an incompatible payload (the gang worker
+        maps it to an error response, never a corrupted pool)."""
+        B = self.serve.kv_block
+        nb = payload.n_blocks
+        if len(tokens) != nb * B:
+            raise ValueError(
+                f"payload covers {nb} block(s) of {B} but {len(tokens)} "
+                "tokens were named"
+            )
+        why = payload_compatible(self.cache, payload)
+        if why:
+            raise ValueError(f"incompatible handoff payload: {why}")
+        toks = [int(t) for t in tokens]
+        if self._store is None:
+            # no store to own them — nothing adopts, nothing strands
+            self._c_handoff_freed.inc(nb)
+            return 0, nb
+        have = len(self._store.match(toks, nb * B).full)
+        new_pids: list[int] = []
+        for bi in range(have, nb):
+            pid = self._alloc_block()
+            if self.cache.quantized:
+                # the adopt write lands the shipped scale row verbatim —
+                # a queued allocation-time scale zeroing would erase it
+                self._fresh_scale.remove(pid)
+            self.cache = write_block(self.cache, pid, payload, bi)
+            new_pids.append(pid)
+        phys = list(self._store.match(toks, nb * B).full)[:have] + new_pids
+        created = self._store.insert(toks, phys, self._pool.retain)
+        for pid in new_pids:
+            self._pool.release(pid)
+        if self._store.evict_to_budget(self._pool.release):
+            self._maybe_shrink_pool()
+        self._g_prefix_bytes.set(self._store.resident_bytes)
+        self._g_prefix_nodes.set(self._store.n_nodes)
+        self._c_handoff_adopted.inc(created)
+        self._c_handoff_freed.inc(nb - created)
+        return created, nb - created
 
     def _maybe_shrink_pool(self) -> None:
         """Halve the pool while the trailing half is entirely free — a
@@ -1244,7 +1478,10 @@ class Engine:
         # dispatch) — position pos autoregressively, pos..pos+draft_len
         # speculatively; the attended table width tracks the live maximum
         B = self.serve.kv_block
-        live_before = [s for s, r in enumerate(self._slot_rid) if r is not None]
+        live_before = [
+            s for s, r in enumerate(self._slot_rid)
+            if r is not None and s not in self._chunking
+        ]
         drafts_np, dlens = self._propose_step_drafts(live_before)
         spec_step = any(dlens)
         need = 1

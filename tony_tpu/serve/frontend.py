@@ -88,42 +88,51 @@ class FrontendRejected(RuntimeError):
 
 
 class AutoscalePolicy:
-    """Grow/shrink decisions from sustained aggregate queue depth.
+    """Grow/shrink decisions from sustained queue depth, tracked PER POOL.
 
-    ``observe()`` returns "grow" once the depth has stayed at or above
-    ``high`` for ``window_s`` continuously, "shrink" once it has stayed
-    at or below ``low`` for the window, else None. Each decision resets
-    its window, so a persistent overload emits one grow per window —
-    paced, not a thundering herd. ``high`` <= 0 disables the policy.
+    ``observe()`` returns "grow" once a pool's depth has stayed at or
+    above ``high`` for ``window_s`` continuously, "shrink" once it has
+    stayed at or below ``low`` for the window, else None. Each decision
+    resets that pool's window, so a persistent overload emits one grow
+    per window — paced, not a thundering herd. Every ``pool`` (task
+    type) gets its OWN window state: a saturated prefill pool grows
+    without the decode pool's idle queue masking it, and vice versa.
+    Callers that predate pools omit ``pool`` and get the single default
+    window — the old gang-wide behavior. ``high`` <= 0 disables the
+    policy.
     """
 
     def __init__(self, high: int, low: int, window_s: float):
         self.high = int(high)
         self.low = int(low)
         self.window_s = float(window_s)
-        self._above_since: float | None = None
-        self._below_since: float | None = None
+        # pool -> [above_since, below_since] window state
+        self._windows: dict[str, list[float | None]] = {}
 
-    def observe(self, queue_depth: int, now: float | None = None) -> str | None:
+    def observe(
+        self, queue_depth: int, now: float | None = None,
+        pool: str = "decode",
+    ) -> str | None:
         if self.high <= 0:
             return None
         now = time.monotonic() if now is None else now
+        w = self._windows.setdefault(pool, [None, None])
         if queue_depth >= self.high:
-            self._below_since = None
-            if self._above_since is None:
-                self._above_since = now
-            elif now - self._above_since >= self.window_s:
-                self._above_since = None
+            w[1] = None
+            if w[0] is None:
+                w[0] = now
+            elif now - w[0] >= self.window_s:
+                w[0] = None
                 return "grow"
         elif queue_depth <= self.low:
-            self._above_since = None
-            if self._below_since is None:
-                self._below_since = now
-            elif now - self._below_since >= self.window_s:
-                self._below_since = None
+            w[0] = None
+            if w[1] is None:
+                w[1] = now
+            elif now - w[1] >= self.window_s:
+                w[1] = None
                 return "shrink"
         else:
-            self._above_since = self._below_since = None
+            w[0] = w[1] = None
         return None
 
 
@@ -137,6 +146,7 @@ class _Host:
     assigned: int = 0        # frontend-routed, not yet finished there
     dead: bool = False
     draining: bool = False
+    pool: str = "decode"     # "decode" | "prefill" (task type's pool)
 
     def load(self) -> float:
         """Routing key: the host's own in-flight view when fresh, plus
@@ -157,6 +167,7 @@ class _Flight:
         self.submit_t = time.perf_counter()
         self.result = GangCompletion(rid=rid)
         self.done = threading.Event()
+        self.handoff_tried = False  # one handoff per request, never on replay
 
 
 class GangFrontend:
@@ -187,6 +198,7 @@ class GangFrontend:
         lease_store=None,
         app_id: str = "",
         grow_ask=None,
+        grow_asks: dict | None = None,
     ):
         self.settings = settings or GangSettings()
         self.app_dir = app_dir
@@ -236,12 +248,23 @@ class GangFrontend:
         # store holds that prefix (bounded LRU; guarded by _lock)
         self._affinity: OrderedDict[int, str] = OrderedDict()
         self._affinity_cap = 4096
-        # the GangAsk one more decode host costs — the REAL container
-        # resources (memory/cpus/tpu_chips of the gang's task type), or a
+        # the GangAsk one more host of each pool costs — the REAL container
+        # resources (memory/cpus/tpu_chips of that pool's task type), or a
         # grow that leases a token ask would leave the new host's chips
-        # looking free to every other job in the store (double-booking)
-        self._grow_ask = grow_ask
+        # looking free to every other job in the store (double-booking).
+        # ``grow_asks`` keys by pool; the legacy ``grow_ask`` is the decode
+        # pool's (a heterogeneous ask must never grow the wrong pool)
+        self._grow_asks: dict = dict(grow_asks or {})
+        if grow_ask is not None:
+            self._grow_asks.setdefault("decode", grow_ask)
         self.autoscale_actions: list[tuple[str, str]] = []  # (action, detail)
+        # blockwise KV handoff records (prefill -> decode), audited by the
+        # handoff-no-block-leak chaos invariant via the ledger
+        self._handoffs: list[dict] = []
+        self._c_handoffs = self.registry.counter(
+            "tony_serve_handoffs_total",
+            "completed prefill->decode block handoffs")
+        self._depth_by_pool: dict[str, int] = {}
         # gang-level live series (obs/series.py): the frontend publishes
         # fleet aggregates — routable hosts, summed queue depth, inflight,
         # windowed gang TTFT — as a scrape source; the stats loop is its
@@ -274,14 +297,22 @@ class GangFrontend:
             out["ttft_p50_s"] = round(d["p50"], 4)
             out["ttft_p99_s"] = round(d["p99"], 4)
             out["ttft_n"] = d["count"]
+        # per-pool depth rollup (disaggregated gangs): the pool label rides
+        # the series key, so portal/`tony top` can split the queues
+        for pool, depth in self._depth_by_pool.items():
+            out[f"queue_depth_{pool}"] = float(depth)
+        if self._handoffs:
+            out["handoffs_total"] = float(self._c_handoffs.value)
         return out
 
     # --- discovery / stats ----------------------------------------------------
 
-    def add_host(self, task_id: str, address: str, attempt: int = 0) -> None:
-        """Register a decode host explicitly (static deployments / tests);
+    def add_host(self, task_id: str, address: str, attempt: int = 0,
+                 pool: str = "decode") -> None:
+        """Register a host explicitly (static deployments / tests);
         AM-discovered jobs never need this."""
-        h = _Host(task_id, address, attempt, ServeRpcClient(address, token=self._token))
+        h = _Host(task_id, address, attempt,
+                  ServeRpcClient(address, token=self._token), pool=pool)
         with self._lock:
             self._hosts[task_id] = h
 
@@ -294,7 +325,12 @@ class GangFrontend:
             infos = self._am.get_task_infos().tasks
         except grpc.RpcError:
             return self._routable_count()
-        seen: dict[str, tuple[str, int]] = {}
+        # task type -> pool: a disaggregated gang contributes two types
+        # (decode + prefill), a classic gang just the decode one
+        pool_types = {self.settings.job_type: "decode"}
+        if self.settings.prefill_hosts > 0:
+            pool_types[self.settings.prefill_job_type] = "prefill"
+        seen: dict[str, tuple[str, int, str]] = {}
         now = time.monotonic()
         with self._lock:
             self._tombstones = {
@@ -302,7 +338,7 @@ class GangFrontend:
             }
             tombstoned = set(self._tombstones)
         for t in infos:
-            if t.job_name != self.settings.job_type or t.port <= 0:
+            if t.job_name not in pool_types or t.port <= 0:
                 continue
             if t.state not in ("REGISTERED", "RUNNING"):
                 continue
@@ -310,23 +346,23 @@ class GangFrontend:
             address = f"{t.host}:{t.port}"
             if (task_id, address, t.attempt) in tombstoned:
                 continue  # the dead incarnation the AM has not replaced yet
-            seen[task_id] = (address, t.attempt)
+            seen[task_id] = (address, t.attempt, pool_types[t.job_name])
         stale: list[_Host] = []
         with self._lock:
             for task_id, h in list(self._hosts.items()):
                 cur = seen.get(task_id)
-                if cur is None or cur != (h.address, h.attempt):
+                if cur is None or cur[:2] != (h.address, h.attempt):
                     # gone, restarted (new attempt), or moved: retire it —
                     # its relays fail over on their next RPC error
                     h.dead = True
                     stale.append(self._hosts.pop(task_id))
             known = set(self._hosts)
-        for task_id, (address, attempt) in seen.items():
+        for task_id, (address, attempt, pool) in seen.items():
             if task_id in known:
                 continue
             h = _Host(
                 task_id, address, attempt,
-                ServeRpcClient(address, token=self._token),
+                ServeRpcClient(address, token=self._token), pool=pool,
             )
             with self._lock:
                 self._hosts[task_id] = h
@@ -344,9 +380,12 @@ class GangFrontend:
             )
 
     def wait_ready(self, n_hosts: int | None = None, timeout_s: float = 180.0) -> int:
-        """Block until ``n_hosts`` (default: the configured gang size)
-        decode hosts answer DecodeStats. Raises TimeoutError otherwise."""
-        want = n_hosts or self.settings.hosts
+        """Block until ``n_hosts`` (default: the configured gang size,
+        both pools) hosts answer DecodeStats. Raises TimeoutError
+        otherwise."""
+        want = n_hosts or (
+            self.settings.hosts + max(self.settings.prefill_hosts, 0)
+        )
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             self.refresh_hosts()
@@ -377,57 +416,89 @@ class GangFrontend:
         while not self._closed.wait(self.STATS_INTERVAL_S):
             self.refresh_hosts()
             depth = 0
+            by_pool: dict[str, int] = {}
             for h in self._snapshot_hosts():
                 try:
                     h.stats = h.client.decode_stats(timeout_s=2.0)
                     h.draining = h.stats.draining
                     depth += h.stats.queue_depth
+                    by_pool[h.pool] = by_pool.get(h.pool, 0) + h.stats.queue_depth
                 except grpc.RpcError:
                     # unreachable != dead (it may be mid-restart); relays
                     # decide on their own stream errors
                     h.stats = None
             self._g_hosts.set(self._routable_count())
             self._fleet_depth = depth
-            self.autoscale_tick(depth)
+            self._depth_by_pool = by_pool
+            self.autoscale_tick(by_pool if by_pool else depth)
             series.sample()  # stride-counted gang-level series scrape
 
     # --- autoscale ------------------------------------------------------------
 
-    def autoscale_tick(self, queue_depth: int, now: float | None = None) -> str | None:
+    def autoscale_tick(
+        self, queue_depth: "int | dict[str, int]", now: float | None = None
+    ) -> str | None:
         """Feed the sustained-queue-depth policy; apply a grow/shrink to
         the lease store when one is attached (the `tony serve` CLI passes
-        the job's store + app id). Always records the decision so tests
-        and operators can see what WOULD have happened."""
-        action = self.autoscaler.observe(queue_depth, now)
-        if action is None:
-            return None
-        detail = f"queue_depth={queue_depth}"
-        if self._lease_store is not None and self._app_id:
-            try:
-                if action == "grow":
-                    if self._grow_ask is None:
-                        detail += (
-                            " -> no grow_ask configured (pass the gang's "
-                            "container GangAsk); decision recorded only"
-                        )
+        the job's store + app id). Accepts the legacy int (decode-pool
+        depth) or a per-pool ``{pool: depth}`` dict — each pool ticks its
+        OWN policy window, and a grow leases that pool's own GangAsk (a
+        heterogeneous ask must never grow the wrong pool). Always records
+        decisions so tests and operators can see what WOULD have
+        happened. Returns the last action taken (tests observe one pool
+        at a time)."""
+        depths = (
+            queue_depth if isinstance(queue_depth, dict)
+            else {"decode": int(queue_depth)}
+        )
+        last: str | None = None
+        for pool in sorted(depths):
+            depth = depths[pool]
+            action = self.autoscaler.observe(depth, now, pool=pool)
+            if action is None:
+                continue
+            # the decode pool keeps the pre-pool gang id so an upgraded
+            # frontend keeps growing the reservation it already holds
+            gang_id = (
+                "serve-autoscale" if pool == "decode"
+                else f"serve-autoscale-{pool}"
+            )
+            detail = f"pool={pool} queue_depth={depth}"
+            if self._lease_store is not None and self._app_id:
+                try:
+                    if action == "grow":
+                        ask = self._grow_asks.get(pool)
+                        if ask is None:
+                            detail += (
+                                " -> no grow_ask configured for this pool "
+                                "(pass its container GangAsk); decision "
+                                "recorded only"
+                            )
+                        else:
+                            host = self._lease_store.grow_gang(
+                                self._app_id, gang_id, ask,
+                            )
+                            detail += (
+                                f" -> leased {host}" if host
+                                else " -> no capacity"
+                            )
                     else:
-                        host = self._lease_store.grow_gang(
-                            self._app_id, "serve-autoscale", self._grow_ask,
+                        freed = self._lease_store.shrink_gang(
+                            self._app_id, gang_id
                         )
                         detail += (
-                            f" -> leased {host}" if host else " -> no capacity"
+                            f" -> freed {freed}" if freed
+                            else " -> nothing to free"
                         )
-                else:
-                    freed = self._lease_store.shrink_gang(
-                        self._app_id, "serve-autoscale"
-                    )
-                    detail += f" -> freed {freed}" if freed else " -> nothing to free"
-            except Exception as e:
-                detail += f" -> store error {e}"
-        log.warning("autoscale %s (%s)", action, detail)
-        trace.instant("serve.autoscale", action=action, detail=detail)
-        self.autoscale_actions.append((action, detail))
-        return action
+                except Exception as e:
+                    detail += f" -> store error {e}"
+            log.warning("autoscale %s (%s)", action, detail)
+            trace.instant(
+                "serve.autoscale", action=action, pool=pool, detail=detail
+            )
+            self.autoscale_actions.append((action, detail))
+            last = action
+        return last
 
     # --- submission / routing -------------------------------------------------
 
@@ -497,7 +568,7 @@ class GangFrontend:
         with self._lock:
             alive = [
                 h for h in self._hosts.values()
-                if not (h.dead or h.draining)
+                if not (h.dead or h.draining) and h.pool == "decode"
             ]
             preferred = [h for h in alive if h.task_id not in exclude] or alive
             if not preferred:
@@ -530,6 +601,72 @@ class GangFrontend:
                     self._affinity.popitem(last=False)
             best.assigned += 1
             return best
+
+    def _pick_prefill_host(self) -> _Host | None:
+        """Least-loaded live prefill-pool host (no affinity: prefill work
+        is one-shot, the blocks leave with the handoff)."""
+        with self._lock:
+            alive = [
+                h for h in self._hosts.values()
+                if not (h.dead or h.draining) and h.pool == "prefill"
+            ]
+            if not alive:
+                return None
+            best = min(alive, key=lambda h: h.load())
+            best.assigned += 1
+            return best
+
+    def _handoff(self, flight: _Flight, decode_host: _Host) -> None:
+        """Disaggregated prefill: route the prompt through a prefill host,
+        which ships the finished KV blocks to ``decode_host`` before the
+        Generate lands there (its admission then sees a prefix hit).
+        Failure is deliberately non-fatal — the decode host re-prefills
+        whatever never arrived, correctness never depends on the handoff.
+        Every attempt is recorded in the ledger; the handoff-no-block-leak
+        chaos invariant audits shipped == adopted + freed post-mortem."""
+        ph = self._pick_prefill_host()
+        if ph is None:
+            return
+        rec = {
+            "rid": flight.rid, "prefill_host": ph.task_id,
+            "decode_host": decode_host.task_id, "shipped": 0, "adopted": 0,
+            "freed": 0, "bytes": 0, "ms": 0.0, "ok": False, "message": "",
+        }
+        hop = trace.span(
+            "serve.handoff", parent=flight.span.sid or None, rid=flight.rid,
+            prefill=ph.task_id, decode=decode_host.task_id,
+        )
+        try:
+            with hop:
+                resp = ph.client.prefill(
+                    flight.rid, list(flight.req.prompt), decode_host.address,
+                    rng_seed=int(flight.req.rng_seed), timeout_s=600.0,
+                )
+                rec.update(
+                    shipped=int(resp.shipped), adopted=int(resp.adopted),
+                    freed=int(resp.freed), bytes=int(resp.bytes),
+                    ms=round(resp.ms, 3), ok=bool(resp.ok),
+                    message=resp.message,
+                )
+                hop.set(ok=resp.ok, shipped=resp.shipped)
+        except grpc.RpcError as e:
+            # prefill host lost mid-handoff: tombstone it and move on — the
+            # decode host re-prefills, and the unadopted export dies with
+            # the dead host's pool (nothing strands on a survivor)
+            rec["message"] = (
+                f"prefill host lost: {getattr(e, 'code', lambda: e)()}"
+            )
+            log.warning(
+                "%s: handoff via %s failed (%s); decode host re-prefills",
+                flight.rid, ph.task_id, rec["message"],
+            )
+            self._host_errored(ph)
+        finally:
+            with self._lock:
+                ph.assigned = max(ph.assigned - 1, 0)
+                self._handoffs.append(rec)
+            if rec["ok"]:
+                self._c_handoffs.inc()
 
     def _relay(self, flight: _Flight) -> None:
         """One request's life: route -> stream -> (on host death: re-queue
@@ -565,6 +702,19 @@ class GangFrontend:
                     continue
                 delivered = len(res.tokens)
                 is_replay = bool(delivered or res.hosts)
+                if (
+                    not is_replay and not flight.handoff_tried
+                    and self.settings.prefill_hosts > 0
+                    and len(flight.req.prompt)
+                    >= max(self.settings.handoff_min_tokens, 1)
+                ):
+                    # disaggregated prefill BEFORE the Generate is routed:
+                    # the decode host is already chosen, so the blocks ship
+                    # exactly where the request will decode. Never retried
+                    # on replay — a replay re-prefills on the survivor,
+                    # which is the correctness path the gang guarantees
+                    flight.handoff_tried = True
+                    self._handoff(flight, host)
                 if is_replay:
                     # parented on the ORIGINAL request span: the merged
                     # trace shows the re-prefill hanging off the request
@@ -768,12 +918,14 @@ class GangFrontend:
     def ledger(self) -> dict:
         with self._lock:
             pending = [f.rid for f in self._flights.values()]
+            handoffs = list(self._handoffs)
         return {
             "proc": self.proc,
             "ttft_budget_s": self.settings.ttft_budget_s,
             "rejected": int(self._c_rejected.value),
             "pending": pending,  # accepted but unfinished at ledger time
             "requests": list(self._ledger),
+            "handoffs": handoffs,  # prefill->decode block-handoff records
         }
 
     def write_ledger(self) -> str | None:

@@ -41,6 +41,7 @@ from typing import Callable
 
 from typing import TYPE_CHECKING
 
+from tony_tpu.chaos import chaos_hook
 from tony_tpu.config.config import TonyConfig
 from tony_tpu.config.keys import Keys
 from tony_tpu.obs import trace
@@ -70,6 +71,15 @@ class GangSettings:
     max_len: int = 0
     max_queue: int = 16
     shard: bool = False
+    # chunked prefill (serve.chunk_tokens): prompts longer than this prefill
+    # in block-aligned chunks, one per decode step; 0 = whole-prompt prefill
+    chunk_tokens: int = 0
+    # disaggregated pools (serve.pool.*): when prefill_hosts > 0 the gang is
+    # heterogeneous — prefill_hosts containers of prefill_job_type run the
+    # prefill pool and ship finished KV blocks to the decode pool
+    prefill_hosts: int = 0
+    prefill_job_type: str = "prefill"
+    handoff_min_tokens: int = 64
     frontend_max_inflight: int = 64
     max_replays: int = 3
     ttft_budget_s: float = 0.0
@@ -104,6 +114,14 @@ class GangSettings:
             max_len=config.get_int(Keys.SERVE_GANG_MAX_LEN, 0),
             max_queue=config.get_int(Keys.SERVE_GANG_MAX_QUEUE, 16),
             shard=config.get_bool(Keys.SERVE_GANG_SHARD, False),
+            chunk_tokens=config.get_int(Keys.SERVE_CHUNK_TOKENS, 0),
+            prefill_hosts=config.get_int(Keys.SERVE_POOL_PREFILL_HOSTS, 0),
+            prefill_job_type=config.get_str(
+                Keys.SERVE_POOL_PREFILL_JOB_TYPE, "prefill"
+            ),
+            handoff_min_tokens=config.get_int(
+                Keys.SERVE_POOL_HANDOFF_MIN_TOKENS, 64
+            ),
             frontend_max_inflight=config.get_int(
                 Keys.SERVE_GANG_MAX_INFLIGHT, 64
             ),
@@ -149,7 +167,7 @@ class GangSettings:
         return cls(**json.loads(blob))
 
 
-def build_gang_engine(settings: GangSettings) -> "Engine":
+def build_gang_engine(settings: GangSettings, pool: str = "decode") -> "Engine":
     """Deterministic per-host engine: same seed -> same weights on every
     replica, so routing (and replay) is host-agnostic. With
     ``serve.gang.shard`` the params shard over the host's local devices
@@ -184,6 +202,8 @@ def build_gang_engine(settings: GangSettings) -> "Engine":
             spec_draft_source=settings.spec_draft_source,
             quant_kv=settings.quant_kv_dtype if settings.quant else "",
             quant_weights=settings.quant and settings.quant_weights,
+            chunk_tokens=settings.chunk_tokens,
+            pool=pool,
         ),
     )
 
@@ -207,9 +227,10 @@ class DecodeHostService(ServeRpcServicer):
     _PUSH_INTERVAL_S = 2.0
 
     def __init__(self, engine_factory: Callable[[], Engine], host_id: str,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0, pool: str = "decode"):
         self._engine_factory = engine_factory
         self.host_id = host_id
+        self.pool = pool
         self._drain_timeout_s = drain_timeout_s
         self._mailbox: queue.Queue = queue.Queue()
         self._stop = threading.Event()
@@ -318,7 +339,28 @@ class DecodeHostService(ServeRpcServicer):
             eng.close()
             self.engine = eng = self._engine_factory()
             done.set()
+        elif kind == "call":
+            # generic engine-thread closure (handoff export/adopt): the RPC
+            # handler blocks on `res`, the engine stays single-threaded
+            _, fn, res = item
+            try:
+                res.put(("ok", fn(eng)))
+            except BaseException as e:
+                res.put(("err", e))
         return eng
+
+    def _call_on_engine(self, fn, timeout_s: float = 120.0):
+        """Run ``fn(engine)`` on the engine thread; raise what it raises.
+        Handler-thread side of the "call" mailbox op."""
+        res: queue.Queue = queue.Queue()
+        self._mailbox.put(("call", fn, res))
+        try:
+            kind, val = res.get(timeout=timeout_s)
+        except queue.Empty:
+            raise TimeoutError("engine call timed out") from None
+        if kind == "err":
+            raise val
+        return val
 
     def _publish(self, eng: Engine) -> None:
         """Push newly decoded tokens to each live stream; close finished
@@ -387,7 +429,7 @@ class DecodeHostService(ServeRpcServicer):
         if eng is None:
             return pb.DecodeStatsResponse(
                 host_id=self.host_id, draining=self._draining,
-                in_flight=pending,
+                in_flight=pending, pool=self.pool,
             )
         # ONE stats surface (Engine.stats_snapshot): the RPC, the series
         # recorder, and the AM push all read the same snapshot — the RPC
@@ -403,7 +445,101 @@ class DecodeHostService(ServeRpcServicer):
             rejected_total=int(snap["rejected_total"]),
             draining=self._draining,
             occupancy=snap["occupancy"],
+            pool=self.pool,
         )
+
+    def Prefill(self, request, context):  # noqa: N802
+        """Disaggregated-prefill entry (frontend -> prefill host): run the
+        prompt's prefill here, then ship the finished full blocks to the
+        decode host named in ``request.target`` via ShipBlocks. The 1-token
+        Generate both executes the prefill and registers the prompt in this
+        host's prefix store, which is what export reads."""
+        t0 = time.monotonic()
+        if self._draining or self._stop.is_set():
+            return pb.PrefillResponse(
+                ok=False, message=f"{self.host_id} is draining"
+            )
+        from tony_tpu.serve.engine import Request
+
+        req = Request(
+            prompt=list(request.prompt), max_new_tokens=1,
+            rng=int(request.rng_seed),
+        )
+        stream = _StreamState(request.rid)
+        self._mailbox.put(("submit", req, stream))
+        for chunk in stream.chunks(context):
+            if chunk.done and chunk.finish_reason not in ("eos", "length"):
+                return pb.PrefillResponse(
+                    ok=False,
+                    message=chunk.message or chunk.finish_reason,
+                )
+            if chunk.done:
+                break
+        out = self._call_on_engine(
+            lambda eng: eng.export_prefix_blocks(list(request.prompt))
+        )
+        if out is None:
+            return pb.PrefillResponse(
+                ok=False, message="no full blocks to ship"
+            )
+        covered, payload = out
+        from tony_tpu.serve.cache import pack_payload
+
+        packed = pack_payload(payload)
+        ship = pb.ShipBlocksRequest(
+            rid=request.rid, src_host=self.host_id, tokens=list(covered),
+            n_blocks=payload.n_blocks, block=int(payload.k.shape[3]),
+            dtype=packed["dtype"], shape=packed["shape"],
+            k=packed["k"], v=packed["v"],
+            k_scale=packed.get("k_scale", b""),
+            v_scale=packed.get("v_scale", b""),
+        )
+        # chaos seam: a fault here (die/hang) models a prefill host lost
+        # mid-handoff — blocks exported but never adopted by the target
+        chaos_hook("serve.handoff", rid=request.rid, target=request.target)
+        from tony_tpu.rpc.service import ServeRpcClient
+
+        try:
+            with ServeRpcClient(request.target) as cli:
+                resp = cli.ship_blocks(ship)
+        except Exception as e:
+            return pb.PrefillResponse(
+                ok=False, shipped=payload.n_blocks,
+                bytes=payload.nbytes,
+                ms=(time.monotonic() - t0) * 1e3,
+                message=f"ship to {request.target} failed: {e}",
+            )
+        return pb.PrefillResponse(
+            ok=resp.ok, shipped=payload.n_blocks, adopted=resp.adopted,
+            freed=resp.freed, bytes=payload.nbytes,
+            ms=(time.monotonic() - t0) * 1e3, message=resp.message,
+        )
+
+    def ShipBlocks(self, request, context):  # noqa: N802
+        """Adopt a shipped block payload into this host's pool + prefix
+        store (decode side of the handoff). Malformed or mismatched
+        payloads are refused — never adopted as garbage."""
+        from tony_tpu.serve.cache import unpack_payload
+
+        try:
+            payload = unpack_payload(
+                bytes(request.k), bytes(request.v), list(request.shape),
+                request.dtype, bytes(request.k_scale), bytes(request.v_scale),
+            )
+        except ValueError as e:
+            return pb.ShipBlocksResponse(ok=False, message=str(e))
+        toks = [int(t) for t in request.tokens]
+        try:
+            adopted, freed = self._call_on_engine(
+                lambda eng: eng.adopt_blocks(toks, payload)
+            )
+        except (ValueError, RuntimeError) as e:
+            return pb.ShipBlocksResponse(ok=False, message=str(e))
+        trace.instant(
+            "serve.adopt", host=self.host_id, rid=request.rid,
+            src=request.src_host, adopted=adopted, freed=freed,
+        )
+        return pb.ShipBlocksResponse(ok=True, adopted=adopted, freed=freed)
 
     def Drain(self, request, context):  # noqa: N802
         """Rolling-restart seam: stop admitting, let live slots finish
@@ -536,13 +672,15 @@ def main() -> int:
 
     profile.install_from_env()
     settings = _load_settings()
-    host_id = (
-        f"{os.environ.get('TONY_JOB_NAME', settings.job_type)}:"
-        f"{os.environ.get('TONY_TASK_INDEX', '0')}"
-    )
+    job_name = os.environ.get("TONY_JOB_NAME", settings.job_type)
+    host_id = f"{job_name}:{os.environ.get('TONY_TASK_INDEX', '0')}"
+    # pool membership comes from the container's task type: a heterogeneous
+    # gang launches prefill_job_type containers next to decode ones, and the
+    # same worker binary serves either side of the handoff
+    pool = "prefill" if job_name == settings.prefill_job_type else "decode"
     service = DecodeHostService(
-        lambda: build_gang_engine(settings), host_id,
-        drain_timeout_s=settings.drain_timeout_s,
+        lambda: build_gang_engine(settings, pool=pool), host_id,
+        drain_timeout_s=settings.drain_timeout_s, pool=pool,
     )
     port = _own_port()
     with trace.span("serve.host_start", host=host_id, port=port):
