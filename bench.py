@@ -429,11 +429,171 @@ def moe_bench(steps: int = 10) -> dict:
     g = per_dispatch.get("grouped", {}).get("tokens_per_sec_per_chip", 0)
     b = per_dispatch.get("gather", {}).get("tokens_per_sec_per_chip", 0)
     if g and b:
+        # the PR-4 gate, resolved round 20: `grouped_vs_gather` is a
+        # perf-diff-judged ratio (higher-better), and the dispatch
+        # decision is recorded as int bits so the diff's flatten (numeric
+        # leaves only) holds them to configuration identity — grouped
+        # ships as the default exactly while the gate holds
         out["grouped_vs_gather"] = round(g / b, 3)
+        out["dispatch_gate_holds"] = int(g > b)
+    out["dispatch_default_grouped"] = int(cfg_for().moe_dispatch == "grouped")
     try:
         out["routing"] = moe_routing_stats(headline_cfg)
     except Exception as e:
         out["routing"] = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+    # the ep-combine overlap, OFF vs ON through the real capture path —
+    # the MoE counterpart of the `overlap` section ('pallas' = the TPU
+    # grouped-GEMM kernel form inside each chunk)
+    try:
+        out["overlap"] = moe_overlap_bench(
+            cfg_for(moe_dispatch="grouped"), batch=8, seq=2048, steps=6,
+            impl="pallas",
+        )
+    except Exception as e:
+        out["overlap"] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    return out
+
+
+def moe_overlap_bench(cfg=None, batch: int = 8, seq: int = 64,
+                      steps: int = 6, impl: str = "scan") -> dict:
+    """The MoE ep-combine overlap section: one expert-parallel train step
+    captured through the real ProfileController path twice — the grouped
+    path's post-FFN combine as the single blocking psum
+    (moe_overlap_impl='off') vs decomposed per-token-chunk partial
+    combines (ops/moe_overlap) — so per-step exposed-collective share and
+    per-collective achieved_gbps for the ep combine land in the committed
+    step-anatomy fixtures next to the dense capture. The ON run's chunk
+    size is solved from the OFF capture's measured bandwidth
+    (chunk_tokens_from_report): the anatomy report drives the knob the
+    report then judges, the same loop the `overlap` section closes for
+    the fsdp/dp collectives."""
+    import dataclasses
+    import glob as _glob
+    import tempfile
+
+    from tony_tpu.models.llama import LlamaConfig
+    from tony_tpu.obs import anatomy, comms
+    from tony_tpu.obs import profile as profile_mod
+    from tony_tpu.ops.moe_overlap import chunk_tokens_from_report, overlap_chunks
+    from tony_tpu.parallel.mesh import (
+        MeshShape, build_mesh, get_default_mesh, set_default_mesh,
+    )
+    from tony_tpu.train.trainer import (
+        default_optimizer, make_train_state, make_train_step,
+    )
+
+    n = len(jax.devices())
+    if n < 2:
+        return {"error": "moe overlap bench needs >= 2 devices (ep ring)"}
+    if cfg is None:
+        cfg = LlamaConfig.tiny_moe()
+    # ep pair (the combine this section decomposes) + dp over the rest so
+    # tokens stay sharded over the data axes, the trainer's MoE shape
+    dp = n // 2 if n >= 4 else 1
+    if batch % max(dp, 1):
+        return {"error": f"batch {batch} does not shard over dp={dp}"}
+    prev_mesh = get_default_mesh()
+    mesh = build_mesh(MeshShape(ep=2, dp=dp))
+    set_default_mesh(mesh)
+    opt = default_optimizer(warmup_steps=2, decay_steps=100)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size
+    )
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    def capture(variant_cfg):
+        state = make_train_state(jax.random.key(0), variant_cfg, mesh, opt)
+        step = make_train_step(variant_cfg, mesh, opt)
+        ledger_rows = []
+        try:
+            compiled = step.lower(state, inputs, targets).compile()
+            ledger_rows = comms.extract_collectives(compiled)
+            step = compiled
+        except Exception:
+            pass  # lazy jit fallback: ledger-less capture still reports
+        out_root = tempfile.mkdtemp(prefix="tony-moe-overlap-")
+        ctl = profile_mod.ProfileController(out_root, "bench", watch=False)
+        state, m = step(state, inputs, targets)  # warm outside the window
+        _fence(m["loss"])
+        ctl.trigger(steps=steps)
+        for _ in range(steps + 1):
+            ctl.step(fetch_s=0.0)
+            state, m = step(state, inputs, targets)
+            _fence(m["loss"])
+        ctl.finish()
+        mpaths = _glob.glob(
+            os.path.join(out_root, "bench", "*", "manifest.json")
+        )
+        if not mpaths:
+            return {"error": "no capture manifest landed"}
+        with open(mpaths[-1]) as fh:
+            manifest = json.load(fh)
+        rep = anatomy.proc_report(manifest, ledger_rows)
+        sec = {
+            "step_ms": rep["per_step_ms"]["step_time_s"],
+            "compute_ms": rep["per_step_ms"]["compute_s"],
+            "exposed_collective_ms": rep["per_step_ms"]["exposed_collective_s"],
+            "loss": round(float(m["loss"]), 4),
+        }
+        for k in ("overlap_frac", "pure_comm_steps"):
+            if k in rep:
+                sec[k] = rep[k]
+        top = next(
+            (r for r in rep["collectives"]
+             if r.get("bytes") and r.get("total_s")),
+            None,
+        )
+        if top is not None:
+            sec["top_collective"] = {
+                "kind": top["kind"], "bytes": top["bytes"],
+            }
+            if "achieved_gbps" in top:
+                sec["top_collective"]["achieved_gbps"] = top["achieved_gbps"]
+        return sec
+
+    try:
+        off = capture(dataclasses.replace(cfg, moe_overlap_impl="off"))
+        if "error" in off:
+            return off
+        # size the chunk from the OFF capture's measured bandwidth; when
+        # the measured size doesn't divide this shape's per-shard rows,
+        # fall back to the auto split rather than silently not overlapping
+        dtype_bytes = 2 if cfg.dtype == jnp.bfloat16 else 4
+        chunk = chunk_tokens_from_report(off, dim=cfg.dim,
+                                         dtype_bytes=dtype_bytes)
+        t_local = (batch * seq) // dp
+        if overlap_chunks(t_local, chunk) is None:
+            chunk = 0
+        on = capture(dataclasses.replace(
+            cfg, moe_overlap_impl=impl, moe_overlap_chunk=chunk,
+        ))
+    finally:
+        set_default_mesh(prev_mesh)
+    out = {
+        "devices": n,
+        "mesh": {"ep": 2, "dp": dp},
+        "impl": impl,
+        "chunk_tokens": chunk,
+        "off": off,
+        "on": on,
+    }
+    if "error" not in on:
+        # lift the judged keys to the section top so perf_diff's dotted
+        # rules (extra.moe_top2.overlap.*) see them without digging into
+        # variants
+        if "overlap_frac" in on:
+            out["overlap_frac"] = on["overlap_frac"]
+        out["exposed_collective_ms"] = on["exposed_collective_ms"]
+        if off.get("exposed_collective_ms"):
+            out["exposed_ratio"] = round(
+                on["exposed_collective_ms"] / off["exposed_collective_ms"], 4
+            )
+        if off.get("step_ms"):
+            out["step_ms_ratio"] = round(on["step_ms"] / off["step_ms"], 4)
+        # value-safety receipt: same batch/state both variants — the
+        # decomposed combine is an execution schedule, not a new model
+        if "loss" in off and "loss" in on:
+            out["loss_delta"] = round(abs(on["loss"] - off["loss"]), 6)
     return out
 
 
@@ -1483,6 +1643,14 @@ def run_bench() -> dict:
         extra["overlap"] = _phased(
             "overlap", lambda: collective_overlap_bench(cfg, batch=8, seq=64)
         )
+        # the MoE ep-combine counterpart through the same capture path
+        # (tiny_moe on the virtual-device mesh; the full moe_top2 sweep is
+        # TPU-only, but the overlap capture itself must run everywhere)
+        extra["moe_top2"] = _phased("moe_top2", lambda: {
+            "overlap": moe_overlap_bench(
+                LlamaConfig.tiny_moe(), batch=8, seq=64, steps=6, impl="scan"
+            ),
+        })
         extra["elastic"] = _phased("elastic", elastic_bench)
         return {
             "metric": "llama_tiny_cpu_tokens_per_sec",

@@ -183,6 +183,20 @@ def _fit_config_section() -> list[str]:
         "moe_group_block": "grouped-GEMM row tile override (0 keeps "
                            "`model.moe_group_block`); each expert's ragged "
                            "token group pads up to a multiple of this",
+        "moe_overlap_impl": "overlapped expert-parallel combine override: "
+                            "empty keeps `model.moe_overlap_impl`; scan / "
+                            "pallas decompose the post-FFN ep psum into "
+                            "per-token-chunk partial combines that overlap "
+                            "the next chunk's grouped FFN "
+                            "(`tony_tpu.ops.moe_overlap` — docs/PERF.md "
+                            "\"Round 20\"). Needs ep > 1 and grouped "
+                            "dispatch; declines cleanly otherwise",
+        "moe_overlap_chunk": "tokens per combine chunk (0 sizes from the "
+                             "measured anatomy report via "
+                             "`ops.moe_overlap.chunk_tokens_from_report`, "
+                             "or auto-picks a divisor); must divide the "
+                             "per-shard token count and leave >= 2 chunks, "
+                             "else the single-psum path is kept",
         "elastic_members": "elastic gang size at full strength (0 disables; "
                            ">= 2 makes the mesh runtime-swappable — dp maps "
                            "to members and shrinks/grows at generation "

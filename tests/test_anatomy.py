@@ -416,8 +416,13 @@ class TestReport:
 # --- end-to-end: the AM broadcast over a real 2-host job ----------------------
 
 
+@pytest.mark.slow  # ~18s: full client->AM->2-executor process stack;
+# the capture path (ProfileController window, manifest, proc_report
+# math, comms extraction) stays tier-1 in this file's unit/controller
+# tests — only the fleet broadcast fan-out re-pays processes here
+# (round 20 offsets)
 def test_profile_fleet_capture_end_to_end(tmp_path):
-    """Tier-1 acceptance: a REAL client -> AM -> 2-executor job; `tony
+    """Acceptance e2e: a REAL client -> AM -> 2-executor job; `tony
     profile <app> --steps 2` broadcast over the StartProfile RPC while the
     workers boot; BOTH hosts capture the window via the app-dir broadcast
     file; the report merges both with a critical path, each host's budget

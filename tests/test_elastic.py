@@ -565,8 +565,13 @@ class TestElasticFit:
 # --- end-to-end: preemption survived without a cold restart -------------------
 
 
+@pytest.mark.slow  # ~34s: full client->AM->2-member process stack; the
+# shrink/grow trainer contract stays tier-1 via TestProtocol /
+# TestElasticInvariants / TestLeaseElastic, and the fit-level shrink-grow
+# trajectory already lives in the slow tier (TestElasticFit) — round 20
+# offsets for the moe-overlap suite
 def test_elastic_job_end_to_end(tmp_path):
-    """Tier-1 acceptance (ISSUE 14): a REAL client -> AM -> 2-member
+    """Acceptance e2e (ISSUE 14): a REAL client -> AM -> 2-member
     elastic training job. Chaos kill_container takes the member agent's
     host down only once training is provably mid-step (on_file armed by
     the trainer's own metrics hook); the AM declares a shrink generation,

@@ -148,6 +148,25 @@ class TestGL002:
         """}, select="GL002")
         assert [f.detail for f in fs] == ["shape-keyed-jit-in-loop"]
 
+    def test_fires_on_jit_of_partial_built_and_called_per_dispatch(self, tmp_path):
+        """The per-dispatch twin of the in-loop case — the MoE routing
+        shape: a dispatch helper that re-wraps its kernel around the
+        current config in the same expression that calls it. No loop in
+        sight, but the caller IS the loop (one routing call per step), so
+        every dispatch pays a full recompile."""
+        fs = lint_src(tmp_path, {"mod.py": """
+            import functools
+            import jax
+
+            def _expert_ffn(x, w, n_experts):
+                return x @ w
+
+            def route_tokens(x, w, n_experts):
+                return jax.jit(functools.partial(_expert_ffn, n_experts=n_experts))(x, w)
+        """}, select="GL002")
+        assert [f.detail for f in fs] == ["jit-per-dispatch"]
+        assert "route_tokens" in fs[0].symbol
+
     def test_silent_on_hoisted_jit_of_partial(self, tmp_path):
         """The FIX shapes must not fire: a jit-of-partial built once
         outside the loop (the serve/engine.py AOT-family idiom) and

@@ -249,6 +249,11 @@ class TestModelAndTrainer:
         # f32 ring accumulation reorders sums: equal to ~1e-6, not bitwise
         assert abs(base - ov) < 2e-5
 
+    @pytest.mark.slow  # ~20s: three full dense fits; the psum-of-tuple
+    # bitwise contract stays tier-1 at unit level
+    # (TestBucketing.test_bucketed_psum_bitwise_equals_whole_tree_psum)
+    # and at trainer level on the MoE model
+    # (test_moe_overlap.TestTrainerComposition) — round 20 offsets
     def test_bucketed_trainer_loss_trajectory_bitwise_identical(self):
         """Bucketing the dp grad reduce is a schedule change only: within
         the manual decomposition, one big bucket and many small buckets

@@ -383,6 +383,10 @@ def test_pipeline_rejects_sequence_parallel_attention(impl):
         step(state, jnp.asarray(tokens[:, :-1]), jnp.asarray(tokens[:, 1:]))
 
 
+@pytest.mark.slow  # ~14s full fit; the ep training contract stays
+# tier-1 on the dp×ep mesh in test_moe_overlap.TestTrainerComposition
+# (three trainer runs incl. the GSPMD reference) — this keeps the
+# fsdp×ep×tp mesh-shape variant in the full suite (round 20 offsets)
 def test_fit_moe_expert_parallel_tiny_model():
     """EP is a first-class fit() axis: LlamaConfig.tiny_moe trains with the
     expert dim sharded over mesh_shape.ep."""

@@ -9,9 +9,12 @@ GL001    host-sync-in-jit      ``.item()`` / ``float(tracer)`` / ``np.asarray``
                                reachable from a jit entry point
 GL002    recompile-hazard      ``jax.jit`` in a loop, jit-of-partial in a
                                loop (shape-keyed bucket dispatch re-jitting
-                               per step), jit-of-lambda inside a function
-                               body, Python branch on a traced value,
-                               mutable default behind ``static_argnums``
+                               per step), ``jit(partial(...))(...)`` built
+                               and called in one expression (per-dispatch
+                               rebuild — the MoE routing shape),
+                               jit-of-lambda inside a function body, Python
+                               branch on a traced value, mutable default
+                               behind ``static_argnums``
 GL003    donation-reuse        reading an argument after passing it to a
                                ``donate_argnums`` jit in the same scope
 GL004    lock-discipline       blocking calls (sleep, unbounded join/wait/
@@ -248,8 +251,45 @@ class RecompileHazard:
     def run(self, project: Project) -> Iterator[Finding]:
         em = _Emitter(self.CODE)
         yield from self._jit_in_loop(project, em)
+        yield from self._jit_per_dispatch(project, em)
         yield from self._jit_call_hazards(project, em)
         yield from self._branch_on_tracer(project, em)
+
+    def _jit_per_dispatch(self, project: Project, em: _Emitter) -> Iterator[Finding]:
+        """``jax.jit(partial(...))(x)`` built and invoked in ONE expression
+        inside a function body — the per-dispatch twin of the in-loop
+        case (the MoE routing-path shape: re-wrapping a dispatch kernel
+        around the current config on every routing call). The partial is
+        a fresh callable per call, so the jit cache key never repeats and
+        every dispatch recompiles — no loop needed, the caller IS the
+        loop. Hoisted jit-of-partial (assigned once, dispatched later)
+        and cached factories stay silent."""
+        for mi in project.modules.values():
+            for fi in mi.funcs.values():
+                for node in walk_own(fi.node):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Call)):
+                        continue
+                    inner = node.func
+                    if project.dotted_resolved(mi, inner.func) not in (
+                            "jax.jit", "jit", "pjit", "jax.pjit"):
+                        continue
+                    wrapped = inner.args[0] if inner.args else None
+                    if (isinstance(wrapped, ast.Call)
+                            and project.dotted_resolved(mi, wrapped.func)
+                            in ("functools.partial", "partial")):
+                        yield em.emit(
+                            mi.sf.path, node.lineno, fi.local,
+                            "`jax.jit(partial(...))(...)` built and called "
+                            "in one expression: the partial is a fresh "
+                            "callable every dispatch, so the jit cache "
+                            "never hits and every call recompiles — the "
+                            "per-dispatch twin of the in-loop hazard. "
+                            "Build the jitted callable once (hoist it, or "
+                            "memoize keyed by the static config) and "
+                            "dispatch through it",
+                            "jit-per-dispatch",
+                        )
 
     def _jit_in_loop(self, project: Project, em: _Emitter) -> Iterator[Finding]:
         for mi in project.modules.values():

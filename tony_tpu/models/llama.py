@@ -68,16 +68,26 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
-    # 'gather' / 'einsum' (fixed-capacity slots, overflow tokens dropped) |
-    # 'grouped' (dropless sorted grouped GEMM — no capacity, no drops; see
-    # parallel.moe and docs/PERF.md "Grouped MoE")
-    moe_dispatch: str = "gather"
+    # 'grouped' (dropless sorted grouped GEMM — no capacity, no drops; the
+    # default since round 20's judged `grouped_vs_gather` bench gate held)
+    # | 'gather' / 'einsum' (fixed-capacity slots, overflow tokens dropped
+    # — one knob away; see parallel.moe and docs/PERF.md "Grouped MoE")
+    moe_dispatch: str = "grouped"
     # moe_dispatch='grouped': row-tile of the grouped GEMM (each expert's
     # ragged token group pads up to a multiple of this)
     moe_group_block: int = 128
     # moe_dispatch='grouped': 'scan' (pure-XLA, runs anywhere — default) |
     # 'pallas' (TPU kernel, tony_tpu.ops.grouped_mm)
     moe_gmm_impl: str = "scan"
+    # moe_dispatch='grouped' on an ep mesh: 'off' = single blocking post-
+    # FFN combine psum (default); 'scan' | 'pallas' = decomposed per-token-
+    # chunk partial combines so expert compute overlaps combine traffic
+    # (tony_tpu.ops.moe_overlap, docs/PERF.md "Round 20"). Declines to the
+    # single psum wherever the chunk split doesn't apply.
+    moe_overlap_impl: str = "off"
+    # moe_overlap_impl != 'off': tokens per combine chunk per shard (0 =
+    # auto split; size measured captures via moe_overlap.chunk_tokens_from_report)
+    moe_overlap_chunk: int = 0
     moe_aux_coef: float = 0.01
     # loss head (tony_tpu.ops.fused_ce): 'scan' = fused chunked CE via
     # lax.scan (default — never materialises [B,S,V] logits, runs anywhere);
@@ -423,7 +433,8 @@ def moe_ffn_block(x: jax.Array, lp: Params, cfg: LlamaConfig):
         dim=cfg.dim, ffn_dim=cfg.ffn_dim, n_experts=cfg.n_experts,
         top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
         dispatch=cfg.moe_dispatch, group_block=cfg.moe_group_block,
-        gmm_impl=cfg.moe_gmm_impl,
+        gmm_impl=cfg.moe_gmm_impl, overlap_impl=cfg.moe_overlap_impl,
+        overlap_chunk=cfg.moe_overlap_chunk,
     )
     return moe_block(
         {"router": lp["router"], "w1": lp["w1"], "w3": lp["w3"], "w2": lp["w2"]},

@@ -79,6 +79,18 @@ DEFAULT_RULES: tuple[tuple[str, str, float], ...] = (
     (r"tpot_p99_chunked_ratio", "lower", 0.10),
     (r"(chunk_tokens|long_prompt_tokens)", "config", 0.0),
     (r"handoff_.*(bytes|blocks)", "skip", 0.0),
+    # MoE fast path (parallel/moe + ops/moe_overlap, bench `moe_top2`):
+    # the PR-4 dispatch gate, resolved — the grouped/gather tokens-per-sec
+    # ratio is the judged headline (higher is better; it carries no
+    # throughput token so it would otherwise go unjudged), and the
+    # recorded dispatch decision bits are configuration identity: a
+    # silent flip back to gather (or the gate silently ceasing to hold
+    # while grouped stays default) must surface as a diff failure, not
+    # hide inside a judged metric. The overlap section's chunk size rides
+    # the `chunk_tokens` config rule above; its exposed/overlap keys ride
+    # the step-anatomy rules below.
+    (r"grouped_vs_gather", "higher", 0.05),
+    (r"dispatch_(gate_holds|default_grouped)", "config", 0.0),
     # throughput-shaped (and headroom: MORE free HBM is better — this
     # must outrank the broad memory rule below or a headroom collapse
     # would be judged as a memory improvement): higher is better

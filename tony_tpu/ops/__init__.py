@@ -3,7 +3,9 @@
 from tony_tpu.ops.attention import flash_attention
 from tony_tpu.ops.fused_ce import fused_ce_tokens
 from tony_tpu.ops.grouped_mm import grouped_layout, grouped_matmul
+from tony_tpu.ops.moe_overlap import overlapped_combine
 
 __all__ = [
     "flash_attention", "fused_ce_tokens", "grouped_layout", "grouped_matmul",
+    "overlapped_combine",
 ]

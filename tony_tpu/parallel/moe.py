@@ -22,7 +22,9 @@ The expert dim is a logical axis ("expert") the sharding rules map onto the
 mesh's ``ep`` axis; the grouped path additionally ships an explicit
 shard_map-over-ep formulation (each shard runs the grouped FFN for its local
 experts only and the combine is a psum) used automatically when a default
-mesh with ``ep > 1`` is registered.
+mesh with ``ep > 1`` is registered. ``MoEConfig.overlap_impl`` decomposes
+that combine into per-token-chunk partial psums so expert compute overlaps
+combine traffic (tony_tpu.ops.moe_overlap, docs/PERF.md round 20).
 """
 
 from __future__ import annotations
@@ -43,12 +45,14 @@ class MoEConfig:
     n_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
-    # 'gather' (scatter/gather capacity dispatch, O(T*D) data movement),
-    # 'einsum' (dense one-hot dispatch, O(T*E*C*D) matmul FLOPs — the
-    # reference implementation the others are parity-tested against), or
     # 'grouped' (dropless sorted grouped GEMM — no capacity slots at all;
-    # the recommended path once its bench gate holds, docs/PERF.md).
-    dispatch: str = "gather"
+    # the DEFAULT since round 20, when its PR-4 bench gate "grouped beats
+    # gather tokens/s" was measured to hold and `grouped_vs_gather` became
+    # a perf-diff-judged ratio, docs/PERF.md), 'gather' (scatter/gather
+    # capacity dispatch, O(T*D) data movement — one knob away), or
+    # 'einsum' (dense one-hot dispatch, O(T*E*C*D) matmul FLOPs — the
+    # reference implementation the others are parity-tested against).
+    dispatch: str = "grouped"
     # dispatch='grouped': row-tile size of the grouped GEMM; each expert's
     # ragged group is padded up to a multiple of this (keep it a multiple
     # of 16 so bf16 sublane tiling is happy on TPU)
@@ -57,6 +61,19 @@ class MoEConfig:
     # shard_map and ep-mesh safe, the default) | 'pallas' (TPU kernel with
     # scalar-prefetched tile->expert map; interpret mode on CPU)
     gmm_impl: str = "scan"
+    # dispatch='grouped' on an ep mesh: 'off' keeps the single blocking
+    # post-FFN psum; 'scan' | 'pallas' decompose it into per-token-chunk
+    # partial combines so later chunks' expert FFN overlaps earlier chunks'
+    # combine traffic (tony_tpu.ops.moe_overlap, docs/PERF.md round 20).
+    # The impl names the chunk FFN's grouped-GEMM kernel; the schedule is
+    # identical. Declines cleanly (single psum) when the chunk split
+    # doesn't divide, and rides the ep path's own fallbacks otherwise.
+    overlap_impl: str = "off"
+    # overlap_impl != 'off': tokens per combine chunk, per shard (0 auto-
+    # picks the largest clean split in {4,3,2} chunks; a measured value
+    # comes from ops.moe_overlap.chunk_tokens_from_report). Must divide
+    # the per-shard token count or the overlap declines to the single psum.
+    overlap_chunk: int = 0
 
     def capacity(self, n_tokens: int) -> int:
         """Per-expert token slots; static given the (padded) token count.
@@ -261,6 +278,26 @@ def _moe_grouped(params: dict[str, Any], flat: jax.Array, cfg: MoEConfig,
     return y, aux
 
 
+def _chunk_ffn(w1, w3, w2, flat_, sel_, weight_, *, cfg: MoEConfig,
+               e_local: int):
+    """Shard-local grouped FFN over one token chunk's routes — the body of
+    ``_moe_grouped_ep.local`` restricted to a row slice, shared with the
+    overlapped combine so both schedules run the identical math. Masks the
+    chunk's routes by expert ownership (this shard's contiguous e_local
+    experts, located by ``axis_index("ep")``) and returns the LOCAL partial
+    [t_chunk, D]; the combine psum stays with the caller so forward and
+    backward issue matching (single or decomposed) collectives."""
+    t, k = flat_.shape[0], cfg.top_k
+    off = jax.lax.axis_index("ep") * e_local
+    rel = sel_ - off
+    mine = (rel >= 0) & (rel < e_local)
+    grp = jnp.where(mine, rel, 0).reshape(t * k)
+    wgt = jnp.where(mine, weight_, 0.0).reshape(t * k)
+    tok = jnp.repeat(jnp.arange(t, dtype=jnp.int32), k)
+    return _grouped_ffn({"w1": w1, "w3": w3, "w2": w2}, flat_, tok, grp,
+                        wgt, e_local, cfg)
+
+
 def _moe_grouped_ep(params: dict[str, Any], flat: jax.Array, cfg: MoEConfig,
                     probs: jax.Array, mesh):
     """Expert-parallel grouped dispatch: shard_map where each ``ep`` shard
@@ -273,31 +310,44 @@ def _moe_grouped_ep(params: dict[str, Any], flat: jax.Array, cfg: MoEConfig,
     experts ride along with zero combine weight — the static-shape cost of
     dropless EP, since routing counts are data-dependent). Routing (fp32)
     and the aux loss stay outside the manual region."""
+    from dataclasses import replace
+
     from jax.sharding import PartitionSpec as P
 
     from tony_tpu.ops.compat import shard_map_compat
+    from tony_tpu.ops.moe_overlap import overlap_chunks, overlapped_combine
 
-    k = cfg.top_k
     ep = int(mesh.shape["ep"])
     e_local = cfg.n_experts // ep
     sel, gates, _, aux = _top_k_select(probs, cfg)
     denom = jnp.maximum(jnp.sum(gates, axis=1), 1e-9)
     weight = gates / denom[:, None]                           # [T, k]
 
-    def local(w1, w3, w2, flat_, sel_, weight_):
-        t = flat_.shape[0]                                    # T / (dp*fsdp)
-        off = jax.lax.axis_index("ep") * e_local
-        rel = sel_ - off
-        mine = (rel >= 0) & (rel < e_local)
-        grp = jnp.where(mine, rel, 0).reshape(t * k)
-        wgt = jnp.where(mine, weight_, 0.0).reshape(t * k)
-        tok = jnp.repeat(jnp.arange(t, dtype=jnp.int32), k)
-        y = _grouped_ffn({"w1": w1, "w3": w3, "w2": w2}, flat_, tok, grp,
-                         wgt, e_local, cfg)
-        return jax.lax.psum(y, "ep")
-
     axes = set(mesh.axis_names)
     batch = tuple(a for a in ("dp", "fsdp") if a in axes) or None
+    n_batch = 1
+    for a in batch or ():
+        n_batch *= int(mesh.shape[a])
+
+    n_chunks = None
+    if cfg.overlap_impl and cfg.overlap_impl != "off":
+        # remaining decline leg of the overlap triad (no-ep-axis and
+        # already-manual-region decline the whole ep path upstream): a
+        # chunk size that doesn't divide the per-shard token rows keeps
+        # the single blocking psum below
+        n_chunks = overlap_chunks(flat.shape[0] // n_batch, cfg.overlap_chunk)
+
+    def local(w1, w3, w2, flat_, sel_, weight_):
+        if n_chunks is not None:
+            # the overlap impl names the chunk FFN's grouped-GEMM kernel
+            ffn = partial(_chunk_ffn,
+                          cfg=replace(cfg, gmm_impl=cfg.overlap_impl),
+                          e_local=e_local)
+            return overlapped_combine(ffn, "ep", n_chunks, w1, w3, w2,
+                                      flat_, sel_, weight_)
+        y = _chunk_ffn(w1, w3, w2, flat_, sel_, weight_, cfg=cfg,
+                       e_local=e_local)
+        return jax.lax.psum(y, "ep")
     wspec = P("ep", None, None)
     bspec = P(batch, None)
     y = shard_map_compat(
@@ -352,6 +402,11 @@ def moe_block(params: dict[str, Any], x: jax.Array, cfg: MoEConfig):
     probs = jax.nn.softmax(logits, axis=-1)
 
     if cfg.dispatch == "grouped":
+        if cfg.overlap_impl not in ("", "off", "scan", "pallas"):
+            raise ValueError(
+                f"unknown MoE overlap impl {cfg.overlap_impl!r}; expected "
+                "'off' | 'scan' | 'pallas'"
+            )
         y, aux = _moe_grouped_entry(params, flat, cfg, probs)
         return y.reshape(B, S, D), aux
     capacity = cfg.capacity(T)

@@ -109,6 +109,16 @@ class FitConfig:
     grad_bucket_mb: float = 0.0
     # grouped-GEMM row tile override (0 keeps model.moe_group_block)
     moe_group_block: int = 0
+    # MoE ep-combine overlap override (tony_tpu.ops.moe_overlap, docs/
+    # PERF.md "Round 20"): '' keeps model.moe_overlap_impl; 'scan'/'pallas'
+    # decompose the grouped path's post-FFN combine psum into per-token-
+    # chunk partial combines so expert compute overlaps combine traffic;
+    # 'off' pins the single blocking psum
+    moe_overlap_impl: str = ""
+    # overlap chunk tokens per shard override (0 keeps
+    # model.moe_overlap_chunk; size measured captures via
+    # ops.moe_overlap.chunk_tokens_from_report)
+    moe_overlap_chunk: int = 0
     # elastic training (tony_tpu/elastic/, docs/ELASTIC.md): gang size at
     # full strength. 0 disables; >= 2 makes the mesh runtime-swappable —
     # the dp axis maps to members and shrinks/grows at AM-declared
@@ -398,7 +408,9 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
     # sweeps) must not inherit the first one's peak
     hbm_mark = hbm_watch.mark() if hbm_watch is not None else None
     cfg.apply_job_env()
-    if cfg.ce_impl or cfg.moe_dispatch or cfg.moe_group_block or cfg.overlap_impl:
+    if (cfg.ce_impl or cfg.moe_dispatch or cfg.moe_group_block
+            or cfg.overlap_impl or cfg.moe_overlap_impl
+            or cfg.moe_overlap_chunk):
         from dataclasses import replace as _replace
 
         overrides = {}
@@ -410,6 +422,10 @@ def _fit(cfg: FitConfig, fit_span=trace.NOOP_SPAN) -> dict:
             overrides["moe_group_block"] = cfg.moe_group_block
         if cfg.overlap_impl:
             overrides["overlap_impl"] = cfg.overlap_impl
+        if cfg.moe_overlap_impl:
+            overrides["moe_overlap_impl"] = cfg.moe_overlap_impl
+        if cfg.moe_overlap_chunk:
+            overrides["moe_overlap_chunk"] = cfg.moe_overlap_chunk
         cfg.model = _replace(cfg.model, **overrides)
     cache_dir = os.environ.get("TONY_JAX_CACHE_DIR", "")
     if cache_dir and cfg.elastic_members >= 2:
